@@ -1,0 +1,48 @@
+"""dist_mnist_tpu — a TPU-native SPMD training framework.
+
+A ground-up rebuild of the capabilities of `leo-mao/dist-mnist` (TensorFlow's
+gRPC parameter-server MNIST trainer: ClusterSpec / tf.train.Server /
+replica_device_setter / SyncReplicasOptimizer / MonitoredTrainingSession —
+see SURVEY.md for the full structural analysis of that stack) designed
+TPU-first rather than ported:
+
+- The ps/worker multi-process topology collapses into ONE jit-compiled SPMD
+  program over a `jax.sharding.Mesh` (SURVEY.md §2.5 rows 21-28 are replaced
+  by XLA + libtpu; §2.2 rows 3-5 by `cluster/` + `parallel/`).
+- Gradient push/pull over gRPC (RecvTensor RPC, worker.h:85) becomes an XLA
+  all-reduce over ICI compiled into the step (`parallel/`).
+- SyncReplicasOptimizer's accumulator + token-queue barrier
+  (sync_replicas_optimizer.py:215-338) becomes in-step `psum` plus
+  gradient accumulation for `replicas_to_aggregate < N` (`optim/sync.py`).
+- MonitoredTrainingSession + SessionRunHooks (monitored_session.py:427-609,
+  basic_session_run_hooks.py) become a functional `TrainLoop` with the same
+  hook lifecycle (`train/`, `hooks/`).
+- Saver/checkpoint (saver.py:1186) becomes Orbax-backed restore-or-init
+  (`checkpoint/`).
+
+Public surface is re-exported here; see each subpackage for the mapping to
+the reference component it replaces.
+"""
+
+from dist_mnist_tpu.cluster import ClusterConfig, make_mesh, initialize_distributed
+from dist_mnist_tpu.configs import Config, get_config, CONFIGS
+from dist_mnist_tpu.train.state import TrainState
+from dist_mnist_tpu.train.loop import TrainLoop, StopSignal
+from dist_mnist_tpu.train.step import make_train_step, make_eval_step
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ClusterConfig",
+    "make_mesh",
+    "initialize_distributed",
+    "Config",
+    "get_config",
+    "CONFIGS",
+    "TrainState",
+    "TrainLoop",
+    "StopSignal",
+    "make_train_step",
+    "make_eval_step",
+    "__version__",
+]

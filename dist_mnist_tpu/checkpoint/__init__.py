@@ -1,0 +1,6 @@
+"""Checkpoint/resume — Saver + CheckpointSaverHook + SessionManager restore,
+rebuilt on Orbax/tensorstore (SURVEY.md §2.4 row 19, §3.5, §5.4)."""
+
+from dist_mnist_tpu.checkpoint.manager import CheckpointManager
+
+__all__ = ["CheckpointManager"]

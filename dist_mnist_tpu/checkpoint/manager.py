@@ -1,0 +1,99 @@
+"""Orbax-backed checkpointing of the TrainState pytree.
+
+Reference mapping (SURVEY.md §3.5): graph-embedded SaveV2/RestoreV2 streamed
+PS-resident variables through the chief to a sharded V2 file
+(saver.py:233-312, 1186), `checkpoint` state proto tracked latest
+(checkpoint_management.py:176), `SessionManager.prepare_session` auto-
+restored (:186-257). Here: Orbax writes each process's shards in parallel
+(tensorstore), keeps a step index, GCs to `max_to_keep`, saves async so the
+TPU never waits on disk, and `restore_or_init` is the prepare_session
+analogue.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+import jax
+
+log = logging.getLogger(__name__)
+
+try:
+    import orbax.checkpoint as ocp
+
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover - orbax is expected in this env
+    _HAVE_ORBAX = False
+
+
+class CheckpointManager:
+    """Save/restore `TrainState` with retention + async write.
+
+    `max_to_keep` ≙ tf.train.Saver(max_to_keep=5) default; directory layout
+    is Orbax's step-numbered tree (the analogue of model.ckpt-<step> files +
+    the `checkpoint` proto).
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        max_to_keep: int = 5,
+        async_save: bool = True,
+    ):
+        if not _HAVE_ORBAX:
+            raise RuntimeError("orbax-checkpoint is required for CheckpointManager")
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._last_saved: int | None = None
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def save(self, state) -> bool:
+        """Save if this step isn't already on disk (re-saving an identical
+        step is never useful — e.g. save-on-create right after a restore)."""
+        step = state.step_int
+        if step == self._last_saved or step == self.latest_step():
+            return False
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if saved:
+            self._last_saved = step
+            log.info("checkpoint saved at step %d -> %s", step, self.directory)
+        return bool(saved)
+
+    def restore(self, target_state):
+        """Restore the latest checkpoint into target_state's structure
+        (shardings included — each leaf is restored with the sharding of the
+        matching target leaf, so restore is collective on multi-host).
+        Returns None when no checkpoint exists."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array)
+            else x,
+            target_state,
+        )
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        log.info("restored checkpoint step %d from %s", step, self.directory)
+        return restored
+
+    def restore_or_init(self, init_state):
+        """≙ SessionManager.prepare_session (session_manager.py:259): try the
+        latest checkpoint, else the freshly-initialized state."""
+        restored = self.restore(init_state)
+        return (restored, True) if restored is not None else (init_state, False)
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,1 @@
+"""Command-line entrypoints (the reference repo's driver-script layer)."""

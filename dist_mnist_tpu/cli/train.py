@@ -1,0 +1,293 @@
+"""Training driver — the `dist_mnist.py` replacement (SURVEY.md §0.1).
+
+One SPMD entrypoint: every process runs this same program
+(`python -m dist_mnist_tpu.cli.train --config=lenet5_mnist`). The
+reference's cluster flags are accepted for familiarity but collapsed:
+--job_name/--ps_hosts/--worker_hosts have no meaning without parameter
+servers (a warning explains the mapping); --sync_replicas is the default
+and only mode (SPMD is synchronous); --replicas_to_aggregate maps to
+gradient accumulation (optim/sync.py).
+
+Flag-name parity with the §0.1 table: data_dir, download_only, train_steps,
+batch_size, learning_rate, hidden_units, sync_replicas,
+replicas_to_aggregate, job_name, task_index, num_gpus, existing_servers,
+ps_hosts, worker_hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+from absl import app, flags
+
+log = logging.getLogger(__name__)
+
+FLAGS = flags.FLAGS
+
+# -- reference-parity flags (SURVEY.md §0.1 flag table) ----------------------
+flags.DEFINE_string("data_dir", "/tmp/mnist-data", "dataset directory (IDX files)")
+flags.DEFINE_boolean("download_only", False,
+                     "materialize the dataset (synthetic twin) then exit")
+flags.DEFINE_string("job_name", "", "IGNORED: no ps/worker jobs under SPMD")
+flags.DEFINE_integer("task_index", 0, "IGNORED: use --process_id for multi-host")
+flags.DEFINE_integer("num_gpus", 0, "IGNORED: TPU-native")
+flags.DEFINE_integer("train_steps", None, "global steps (None = config value)")
+flags.DEFINE_integer("batch_size", None, "GLOBAL batch size (None = config)")
+flags.DEFINE_float("learning_rate", None, "LR (None = config value)")
+flags.DEFINE_integer("hidden_units", None, "MLP hidden width (mlp model only)")
+flags.DEFINE_boolean("sync_replicas", True,
+                     "always True under SPMD; False warns (async PS is "
+                     "out-of-model; see parallel/ps_demo)")
+flags.DEFINE_integer("replicas_to_aggregate", None,
+                     "minibatches aggregated per update, as a multiple of the "
+                     "mesh: k means accumulate k steps (None = 1)")
+flags.DEFINE_boolean("existing_servers", False, "IGNORED: no servers to reuse")
+flags.DEFINE_string("ps_hosts", "", "IGNORED: no parameter servers")
+flags.DEFINE_string("worker_hosts", "", "IGNORED: workers = mesh devices")
+
+# -- framework flags ---------------------------------------------------------
+flags.DEFINE_string("config", "mlp_mnist", "config name (see configs.py)")
+flags.DEFINE_string("checkpoint_dir", None, "checkpoint directory (None = off)")
+flags.DEFINE_string("logdir", None, "metrics/profile output directory")
+flags.DEFINE_string("mesh", None, 'mesh override, e.g. "data=8,model=1"')
+flags.DEFINE_string("coordinator_address", None, "host:port of process 0")
+flags.DEFINE_integer("num_processes", 1, "total processes (multi-host)")
+flags.DEFINE_integer("process_id", 0, "this process's index")
+flags.DEFINE_boolean("profile", False, "trace a window of steps to logdir")
+flags.DEFINE_integer("eval_every", None, "eval cadence in steps; 0 disables "
+                     "(None = config value)")
+flags.DEFINE_integer("log_every", None, "log/summary cadence in steps")
+flags.DEFINE_integer("max_recoveries", 3,
+                     "preemption restore attempts (needs checkpoint_dir)")
+
+
+def build_optimizer(cfg):
+    from dist_mnist_tpu import optim
+
+    aggregate = max(1, cfg.replicas_to_aggregate or 1)
+    if cfg.lr_schedule == "cosine":
+        # the schedule is driven by the inner optimizer's UPDATE count, which
+        # advances once per `aggregate` loop steps — scale the horizon so the
+        # decay completes over cfg.train_steps loop steps
+        lr = optim.schedules.cosine_decay(
+            cfg.learning_rate,
+            max(1, cfg.train_steps // aggregate),
+            max(0, cfg.warmup_steps // aggregate),
+        )
+    else:
+        lr = cfg.learning_rate
+    if cfg.optimizer == "adam" and cfg.weight_decay:
+        base = optim.adamw(lr, weight_decay=cfg.weight_decay)
+        wd_handled = True
+    else:
+        base = {
+            "adam": lambda: optim.adam(lr),
+            "sgd": lambda: optim.sgd(lr),
+            "momentum": lambda: optim.momentum(lr, 0.9),
+        }[cfg.optimizer]()
+        wd_handled = False
+    parts = []
+    if cfg.grad_clip_norm:
+        parts.append(optim.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.weight_decay and not wd_handled:
+        parts.append(optim.add_decayed_weights(cfg.weight_decay))
+    parts.append(base)
+    opt = optim.chain(*parts) if len(parts) > 1 else base
+    if aggregate > 1:
+        opt = optim.gradient_accumulation(opt, aggregate)
+    return opt
+
+
+def run_config(
+    cfg,
+    *,
+    data_dir: str = "/tmp/mnist-data",
+    checkpoint_dir: str | None = None,
+    logdir: str | None = None,
+    profile: bool = False,
+    max_recoveries: int = 0,
+    extra_hooks=(),
+    mesh=None,
+):
+    """Programmatic entrypoint (tests/bench call this; main() parses flags).
+
+    Returns (final_state, final_eval_dict, context) where context carries
+    the mesh/model/etc. for callers that keep going.
+    """
+    import jax
+
+    from dist_mnist_tpu import hooks as hooks_lib
+    from dist_mnist_tpu.checkpoint import CheckpointManager
+    from dist_mnist_tpu.cluster import make_mesh, is_chief
+    from dist_mnist_tpu.data import load_dataset, ShardedBatcher
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.obs import make_default_writer
+    from dist_mnist_tpu.ops import losses
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import (
+        TrainLoop,
+        create_train_state,
+        evaluate,
+        make_eval_step,
+        make_train_step,
+    )
+
+    t0 = time.monotonic()
+    mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+    dataset = load_dataset(cfg.dataset, data_dir, seed=cfg.seed)
+    model = get_model(cfg.model, **cfg.model_kwargs)
+    optimizer = build_optimizer(cfg)
+    loss_fn = (
+        losses.clipped_softmax_cross_entropy
+        if cfg.loss == "clipped"
+        else losses.softmax_cross_entropy
+    )
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    sample = dataset.train_images[:1]
+    with mesh:
+        state = create_train_state(model, optimizer, rng, sample)
+        state = shard_train_state(state, mesh)
+
+        manager = None
+        restored = False
+        if checkpoint_dir:
+            manager = CheckpointManager(checkpoint_dir)
+            state, restored = manager.restore_or_init(state)
+        log.info(
+            "config %s: model=%s params on %d devices, restored=%s",
+            cfg.name, cfg.model, jax.device_count(), restored,
+        )
+
+        step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn)
+        eval_step = make_eval_step(model, mesh)
+        eval_fn = lambda s: evaluate(
+            eval_step, s, dataset.test_images, dataset.test_labels, mesh
+        )
+
+        writer = make_default_writer(logdir, chief=is_chief())
+        hooks = [
+            hooks_lib.StopAtStepHook(last_step=cfg.train_steps),
+            hooks_lib.StepCounterHook(
+                every_steps=cfg.log_every, batch_size=cfg.batch_size, writer=writer
+            ),
+            hooks_lib.LoggingHook(every_steps=cfg.log_every),
+            hooks_lib.SummaryHook(writer, every_steps=cfg.log_every),
+            hooks_lib.NaNGuardHook(),
+        ]
+        eval_hook = None
+        if cfg.eval_every:
+            eval_hook = hooks_lib.EvalHook(eval_fn, every_steps=cfg.eval_every,
+                                           writer=writer)
+            hooks.append(eval_hook)
+        if manager:
+            hooks.append(
+                hooks_lib.CheckpointHook(
+                    manager, every_secs=cfg.checkpoint_every_secs
+                )
+            )
+        if profile and logdir:
+            hooks.append(hooks_lib.ProfilerHook(logdir))
+        hooks.extend(extra_hooks)
+
+        batches = ShardedBatcher(dataset, cfg.batch_size, mesh, seed=cfg.seed)
+        loop = TrainLoop(
+            step_fn,
+            state,
+            batches,
+            hooks,
+            checkpoint_manager=manager,
+            max_recoveries=max_recoveries,
+        )
+        state = loop.run()
+        # EvalHook.end already evaluated the final state; don't pay for a
+        # second full test-set pass
+        final = eval_hook.last_result if eval_hook else eval_fn(state)
+    elapsed = time.monotonic() - t0
+    log.info(
+        "done: step=%d test_acc=%.4f test_loss=%.4f wall=%.1fs",
+        state.step_int, final["accuracy"], final["loss"], elapsed,
+    )
+    writer.flush()
+    if manager:
+        manager.close()
+    return state, final, {"mesh": mesh, "model": model, "elapsed": elapsed,
+                          "dataset": dataset}
+
+
+def _apply_flag_overrides(cfg):
+    over = {}
+    if FLAGS.train_steps is not None:
+        over["train_steps"] = FLAGS.train_steps
+    if FLAGS.batch_size is not None:
+        over["batch_size"] = FLAGS.batch_size
+    if FLAGS.learning_rate is not None:
+        over["learning_rate"] = FLAGS.learning_rate
+    if FLAGS.replicas_to_aggregate is not None:
+        over["replicas_to_aggregate"] = FLAGS.replicas_to_aggregate
+    if FLAGS.eval_every is not None:
+        over["eval_every"] = FLAGS.eval_every
+    if FLAGS.log_every is not None:
+        over["log_every"] = FLAGS.log_every
+    if FLAGS.hidden_units is not None:
+        over["model_kwargs"] = {**cfg.model_kwargs,
+                                "hidden_units": FLAGS.hidden_units}
+    if FLAGS.mesh:
+        from dist_mnist_tpu.cluster.mesh import MeshSpec
+
+        kv = dict(part.split("=") for part in FLAGS.mesh.split(","))
+        over["mesh"] = MeshSpec(**{k: int(v) for k, v in kv.items()})
+    return dataclasses.replace(cfg, **over) if over else cfg
+
+
+def main(argv):
+    del argv
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+    )
+    # orbax/absl INFO is extremely chatty (dozens of lines per save);
+    # keep the console to this framework's own logs
+    logging.getLogger("absl").setLevel(logging.WARNING)
+    for name in ("job_name", "ps_hosts", "worker_hosts"):
+        if getattr(FLAGS, name):
+            log.warning(
+                "--%s is a parameter-server-era flag; this framework runs one "
+                "SPMD program over a device mesh (no ps/worker jobs). "
+                "Multi-host: --coordinator_address/--num_processes/--process_id.",
+                name,
+            )
+    if not FLAGS.sync_replicas:
+        log.warning(
+            "--nosync_replicas requested: async parameter-server training is "
+            "architecturally out-of-model for SPMD (SURVEY.md §2.6); training "
+            "proceeds synchronously. See parallel/ps_demo for the protocol demo."
+        )
+
+    from dist_mnist_tpu.cluster import initialize_distributed
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.data import load_dataset
+
+    initialize_distributed(
+        FLAGS.coordinator_address, FLAGS.num_processes, FLAGS.process_id
+    )
+    cfg = _apply_flag_overrides(get_config(FLAGS.config))
+    if FLAGS.download_only:
+        ds = load_dataset(cfg.dataset, FLAGS.data_dir, seed=cfg.seed)
+        log.info("dataset %s ready (%d train / %d test, synthetic=%s)",
+                 ds.name, len(ds.train_labels), len(ds.test_labels), ds.synthetic)
+        return
+    run_config(
+        cfg,
+        data_dir=FLAGS.data_dir,
+        checkpoint_dir=FLAGS.checkpoint_dir,
+        logdir=FLAGS.logdir,
+        profile=FLAGS.profile,
+        max_recoveries=FLAGS.max_recoveries if FLAGS.checkpoint_dir else 0,
+    )
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,0 +1,30 @@
+"""Cluster topology: device meshes and multi-host bootstrap.
+
+Replaces the reference's cluster layer (SURVEY.md §2.2):
+- `tf.train.ClusterSpec` (server_lib.py:242-493) — a job→task→address map —
+  becomes `ClusterConfig` + a `jax.sharding.Mesh` over the visible devices.
+- `tf.train.Server` (server_lib.py:107-239, TF_NewServer → GrpcServer) — the
+  per-process gRPC server whose `join()` was the whole PS main loop — has no
+  equivalent: there are no parameter servers. Multi-host control plane is
+  `jax.distributed.initialize` (the TSL coordination service, the direct
+  descendant of coordination_service_agent.h — SURVEY.md §2.5 row 29).
+"""
+
+from dist_mnist_tpu.cluster.mesh import (
+    ClusterConfig,
+    MeshSpec,
+    make_mesh,
+    local_batch_slice,
+    device_count,
+)
+from dist_mnist_tpu.cluster.coordination import initialize_distributed, is_chief
+
+__all__ = [
+    "ClusterConfig",
+    "MeshSpec",
+    "make_mesh",
+    "local_batch_slice",
+    "device_count",
+    "initialize_distributed",
+    "is_chief",
+]

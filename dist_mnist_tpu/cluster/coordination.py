@@ -1,0 +1,65 @@
+"""Multi-host bootstrap and chief election.
+
+Replaces the reference's process-bootstrap path: `tf.train.Server` startup
+(server_lib.py:107-146 → GrpcServer, grpc_server_lib.h:78-239) and the
+implicit "chief = worker task 0" convention (SURVEY.md §0.1 step 4).
+
+In the SPMD model there is exactly one control-plane service — the TSL
+coordination service reached through `jax.distributed.initialize` — and it
+does only bootstrap, health (heartbeats), and barrier duty over DCN. All
+tensor traffic is in-program XLA collectives over ICI (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Connect this process to the cluster (no-op single-process).
+
+    Counterpart of `tf.train.Server(cluster, job_name, task_index)` — but
+    symmetric: there is no ps/worker split and nothing to `join()`; the
+    coordination service (heartbeats, "Unavailable: Heartbeat timeout"
+    semantics — coordination_service_agent.h:358-365 lineage) detects dead
+    peers instead of the PS surviving them.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and (num_processes is None or num_processes <= 1):
+        log.info("single-process run; skipping jax.distributed.initialize")
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "distributed init: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def is_chief() -> bool:
+    """Process 0 is chief — it owns host-side side effects (checkpoint
+    writes, summary files), mirroring `is_chief = (task_index == 0)` in the
+    reference (SURVEY.md §0.1 step 4). Unlike the reference chief it does NOT
+    own variable init: params are materialized identically on all processes
+    from the same seed, and restore is collective (checkpoint/manager.py)."""
+    return jax.process_index() == 0

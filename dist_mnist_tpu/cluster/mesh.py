@@ -1,0 +1,138 @@
+"""Device-mesh construction — the SPMD replacement for ClusterSpec.
+
+The reference maps work to processes by name (`{"ps": [...], "worker": [...]}`,
+server_lib.py:242) and places ops with replica_device_setter
+(device_setter.py:128-223). Here the topology is a logical `Mesh` with named
+axes, and placement is a `PartitionSpec` per array (see parallel/sharding.py).
+
+Axes (any may be size 1 and is then squeezed out of collectives by XLA):
+- ``data``  — data parallelism; gradients are all-reduced over it.
+- ``model`` — tensor parallelism; weight matrices are sharded over it.
+- ``seq``   — sequence/context parallelism (ring attention, all-to-all).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. ``data=-1`` means "all remaining devices"."""
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
+        fixed = self.model * self.seq
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by model*seq={fixed}"
+                )
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.model}x{self.seq} != {n_devices} devices"
+            )
+        return (data, self.model, self.seq)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """The whole-cluster topology description.
+
+    Counterpart of the reference's flag triple
+    (``--ps_hosts --worker_hosts --task_index``, SURVEY.md §0.1): here a
+    cluster is processes × local devices, with no job-name distinction —
+    every process runs the same SPMD program (process 0 is "chief" only for
+    host-side side effects: logging, checkpoint writes).
+    """
+
+    mesh: MeshSpec = MeshSpec()
+    coordinator_address: str | None = None  # host:port of process 0, multi-host only
+    num_processes: int = 1
+    process_id: int = 0
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axis_names: tuple[str, ...] = AXES,
+) -> Mesh:
+    """Build a named device mesh.
+
+    Uses ``jax.experimental.mesh_utils`` device ordering when available so
+    that the ``data`` axis rides the slowest links and ``model``/``seq``
+    (which carry per-step collectives with tighter latency needs) ride
+    contiguous ICI neighbours.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    if spec.data != -1:
+        # fully-specified mesh may use a subset of visible devices (e.g. the
+        # 4-way config on an 8-device host — ≙ a worker_hosts list shorter
+        # than the machine pool)
+        want = spec.data * spec.model * spec.seq
+        if want > len(devices):
+            raise ValueError(
+                f"mesh needs {want} devices, only {len(devices)} visible"
+            )
+        devices = devices[:want]
+    shape = spec.resolve(len(devices))
+    # Squeeze trailing singleton axes out of the mesh? No — keep all three
+    # axes so PartitionSpecs are uniform across configs; XLA elides
+    # collectives over size-1 axes.
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:  # non-TPU backends can reject topology-aware layout
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=axis_names)
+
+
+def local_batch_slice(global_batch: int, mesh: Mesh) -> tuple[int, int]:
+    """(per-process batch, per-device batch) for a global batch size.
+
+    The reference's ``--batch_size`` was *per worker* (SURVEY.md §0.1 row
+    batch_size); our configs state the *global* batch and shard it over the
+    ``data`` axis. This helper gives each process its slice for host-side
+    loading (`jax.make_array_from_process_local_data` consumes it).
+    """
+    data = mesh.shape[DATA_AXIS]
+    if global_batch % data != 0:
+        raise ValueError(f"global batch {global_batch} % data axis {data} != 0")
+    per_device = global_batch // data
+    n_proc = jax.process_count()
+    if global_batch % n_proc != 0:
+        raise ValueError(f"global batch {global_batch} % processes {n_proc} != 0")
+    return global_batch // n_proc, per_device
+
+
+def validate_mesh(mesh: Mesh) -> None:
+    n = math.prod(mesh.devices.shape)
+    if n != len(np.unique([d.id for d in mesh.devices.flat])):
+        raise ValueError("mesh contains duplicate devices")

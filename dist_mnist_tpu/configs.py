@@ -1,0 +1,108 @@
+"""The benchmark-ladder configs (BASELINE.md / SURVEY.md §0.1).
+
+Each reference config named a cluster shape (ps/worker counts); here the
+same ladder is expressed as a mesh shape — the "1 ps + 2 workers" topology
+is meaningless under SPMD, so configs 2-5 state their data-parallel width
+directly. `batch_size` is GLOBAL (the reference's was per-worker; its
+original dist config = 2 workers × 100 = global 200, preserved here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from dist_mnist_tpu.cluster.mesh import MeshSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    name: str
+    model: str
+    dataset: str
+    batch_size: int  # global
+    train_steps: int
+    learning_rate: float
+    optimizer: str = "adam"  # adam | sgd | momentum
+    model_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    mesh: MeshSpec = MeshSpec()  # data = all devices by default
+    loss: str = "stable"  # "clipped" = reference parity loss
+    lr_schedule: str = "constant"  # constant | cosine
+    warmup_steps: int = 0
+    replicas_to_aggregate: int = 1  # >1 => gradient accumulation (optim/sync.py)
+    grad_clip_norm: float | None = None
+    weight_decay: float = 0.0
+    eval_every: int = 1000
+    log_every: int = 100
+    checkpoint_every_secs: float = 600.0  # CheckpointSaverHook default cadence
+    seed: int = 42
+
+
+CONFIGS = {
+    # 1) the reference driver's own defaults (§0.1 flag table), single chip
+    "mlp_mnist": Config(
+        name="mlp_mnist",
+        model="mlp",
+        dataset="mnist",
+        batch_size=64,
+        train_steps=2000,
+        learning_rate=0.01,
+        loss="clipped",  # bit-comparable with the reference loss
+        model_kwargs={"hidden_units": 100},
+        eval_every=500,
+    ),
+    # 2) "original dist config": LeNet-5, 2 workers x batch 100
+    "lenet5_mnist": Config(
+        name="lenet5_mnist",
+        model="lenet5",
+        dataset="mnist",
+        batch_size=200,
+        train_steps=2000,
+        learning_rate=1e-3,
+        eval_every=500,
+    ),
+    # 3) LeNet-5 / Fashion-MNIST / 4-way DP
+    "lenet5_fashion": Config(
+        name="lenet5_fashion",
+        model="lenet5",
+        dataset="fashion_mnist",
+        batch_size=512,
+        train_steps=3000,
+        learning_rate=1e-3,
+        mesh=MeshSpec(data=4),
+    ),
+    # 4) ResNet-20 / CIFAR-10 / 8-way DP
+    "resnet20_cifar": Config(
+        name="resnet20_cifar",
+        model="resnet20",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=2e-3,
+        lr_schedule="cosine",
+        warmup_steps=200,
+        grad_clip_norm=1.0,
+        mesh=MeshSpec(data=8),
+    ),
+    # 5) ViT-Tiny / CIFAR-10 / pod slice (stretch; attention path)
+    "vit_tiny_cifar": Config(
+        name="vit_tiny_cifar",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        mesh=MeshSpec(data=-1),  # whole slice
+    ),
+}
+
+
+def get_config(name: str, **overrides) -> Config:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown config {name!r}; have {sorted(CONFIGS)}")
+    cfg = CONFIGS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg

@@ -1,0 +1,33 @@
+"""Input pipeline.
+
+Replaces the reference's MNIST input module (SURVEY.md §2.1 row 2:
+`input_data.read_data_sets` + `DataSet.next_batch` — removed from TF 2.x):
+- `idx.py` — our own IDX file codec (the 4-file MNIST on-disk format).
+- `synthetic.py` — deterministic procedural datasets so every config runs
+  (and converges) in an air-gapped environment with no downloads.
+- `datasets.py` — named dataset registry (mnist / fashion_mnist / cifar10)
+  with disk-first, synthetic-fallback loading.
+- `pipeline.py` — deterministic shuffled batching, per-host sharding, and a
+  device-resident fast path that fuses batch sampling into the jit step.
+"""
+
+from dist_mnist_tpu.data.idx import read_idx, write_idx
+from dist_mnist_tpu.data.datasets import Dataset, load_dataset, DATASETS
+from dist_mnist_tpu.data.pipeline import (
+    epoch_batches,
+    ShardedBatcher,
+    DeviceDataset,
+    shard_batch,
+)
+
+__all__ = [
+    "read_idx",
+    "write_idx",
+    "Dataset",
+    "load_dataset",
+    "DATASETS",
+    "epoch_batches",
+    "ShardedBatcher",
+    "DeviceDataset",
+    "shard_batch",
+]

@@ -1,0 +1,144 @@
+"""Named dataset registry: disk-first, synthetic-fallback.
+
+Mirrors the contract of the reference's
+`input_data.read_data_sets(FLAGS.data_dir, one_hot=True)` (SURVEY.md §0.1
+step 1): given a --data_dir it loads the canonical 4-IDX-file layout (MNIST /
+Fashion-MNIST) or the CIFAR-10 python pickles; when the files are absent it
+synthesizes a deterministic procedural twin instead of downloading (this
+environment has no egress). Labels stay integer; one-hot is applied in the
+loss (ops/losses.py), not the pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import pickle
+import tarfile
+from pathlib import Path
+
+import numpy as np
+
+from dist_mnist_tpu.data import synthetic
+from dist_mnist_tpu.data.idx import read_idx
+
+log = logging.getLogger(__name__)
+
+_MNIST_FILES = {
+    "train_x": "train-images-idx3-ubyte",
+    "train_y": "train-labels-idx1-ubyte",
+    "test_x": "t10k-images-idx3-ubyte",
+    "test_y": "t10k-labels-idx1-ubyte",
+}
+
+
+@dataclasses.dataclass
+class Dataset:
+    """In-memory dataset. Images uint8 NHWC; labels int32 [N]."""
+
+    name: str
+    train_images: np.ndarray
+    train_labels: np.ndarray
+    test_images: np.ndarray
+    test_labels: np.ndarray
+    num_classes: int = 10
+    synthetic: bool = False
+
+    @property
+    def image_shape(self) -> tuple[int, ...]:
+        return self.train_images.shape[1:]
+
+    def normalized(self, arr: np.ndarray) -> np.ndarray:
+        """uint8 [0,255] -> float32 [0,1], matching the reference pipeline's
+        1/255 scaling (old DataSet applied it at load; we defer to use time
+        so the resident copy stays uint8 = 4x less HBM)."""
+        return arr.astype(np.float32) / 255.0
+
+
+def _find_idx(data_dir: Path, stem: str) -> Path | None:
+    for cand in (data_dir / stem, data_dir / f"{stem}.gz"):
+        if cand.exists():
+            return cand
+    return None
+
+
+def _load_idx_quad(data_dir: Path) -> dict[str, np.ndarray] | None:
+    paths = {k: _find_idx(data_dir, v) for k, v in _MNIST_FILES.items()}
+    if not all(paths.values()):
+        return None
+    out = {k: read_idx(p) for k, p in paths.items()}
+    out["train_x"] = out["train_x"][..., None]  # HW -> HWC
+    out["test_x"] = out["test_x"][..., None]
+    return out
+
+
+def _load_cifar10_dir(data_dir: Path) -> dict[str, np.ndarray] | None:
+    batch_dir = data_dir / "cifar-10-batches-py"
+    if not batch_dir.exists():
+        tars = list(data_dir.glob("cifar-10-python.tar.gz"))
+        if not tars:
+            return None
+        with tarfile.open(tars[0]) as tf:
+            tf.extractall(data_dir, filter="data")
+        if not batch_dir.exists():
+            return None
+
+    def load_batch(p: Path):
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x, np.asarray(d[b"labels"], np.int32)
+
+    train = [load_batch(batch_dir / f"data_batch_{i}") for i in range(1, 6)]
+    test_x, test_y = load_batch(batch_dir / "test_batch")
+    return {
+        "train_x": np.concatenate([t[0] for t in train]),
+        "train_y": np.concatenate([t[1] for t in train]),
+        "test_x": test_x,
+        "test_y": test_y,
+    }
+
+
+def _synth(name: str, n_train: int, n_test: int, seed: int):
+    gen = {
+        "mnist": synthetic.synthetic_mnist,
+        "fashion_mnist": synthetic.synthetic_fashion_mnist,
+        "cifar10": synthetic.synthetic_cifar10,
+    }[name]
+    tx, ty = gen(n_train, seed=seed, split=0)
+    vx, vy = gen(n_test, seed=seed, split=7)
+    return {"train_x": tx, "train_y": ty, "test_x": vx, "test_y": vy}
+
+
+def load_dataset(
+    name: str,
+    data_dir: str | Path = "/tmp/mnist-data",
+    *,
+    seed: int = 0,
+    synthetic_sizes: tuple[int, int] = (60_000, 10_000),
+) -> Dataset:
+    """Load `name` from data_dir, else synthesize its procedural twin."""
+    if name not in DATASETS:
+        raise KeyError(f"unknown dataset {name!r}; have {sorted(DATASETS)}")
+    data_dir = Path(data_dir)
+    loader = _load_cifar10_dir if name == "cifar10" else _load_idx_quad
+    raw = loader(data_dir) if data_dir.exists() else None
+    is_synth = raw is None
+    if is_synth:
+        log.warning("%s not found under %s — using synthetic twin", name, data_dir)
+        raw = _synth(name, *synthetic_sizes, seed)
+    return Dataset(
+        name=name,
+        train_images=np.ascontiguousarray(raw["train_x"]),
+        train_labels=raw["train_y"].astype(np.int32),
+        test_images=np.ascontiguousarray(raw["test_x"]),
+        test_labels=raw["test_y"].astype(np.int32),
+        synthetic=is_synth,
+    )
+
+
+DATASETS = {
+    "mnist": dict(image_shape=(28, 28, 1), num_classes=10),
+    "fashion_mnist": dict(image_shape=(28, 28, 1), num_classes=10),
+    "cifar10": dict(image_shape=(32, 32, 3), num_classes=10),
+}

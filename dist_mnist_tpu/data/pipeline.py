@@ -1,0 +1,126 @@
+"""Batching + device placement.
+
+Replaces `DataSet.next_batch(batch_size)` (SURVEY.md §2.1 row 2) and the
+feed_dict hop (§3.3: every batch crossed Py→C++→gRPC per step). Two paths:
+
+- `ShardedBatcher`: host-side deterministic shuffled epochs; each process
+  loads only its slice of the global batch and assembles the global array
+  with `jax.make_array_from_process_local_data` (multi-host correct).
+- `DeviceDataset`: the whole dataset resident in HBM (MNIST is ~11 MB as
+  uint8 — SURVEY.md §7 hard part (e): input must never bottleneck the <60 s
+  target), with batch *sampling fused into the jit-compiled step* so the
+  host does zero per-step work.
+
+Determinism: shuffle order = Philox(key=[seed, epoch]) permutation, identical
+on every host; each host reads its disjoint contiguous slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+from dist_mnist_tpu.data.datasets import Dataset
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) dim sharded over the data axis, rest replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def shard_batch(batch: dict[str, np.ndarray], mesh: Mesh) -> dict[str, jax.Array]:
+    """Host-local batch slices -> global device arrays sharded over `data`.
+
+    On one process this is a plain device_put with a sharded layout; on many
+    it stitches each process's slice into one global array (the SPMD
+    equivalent of every worker feeding its own feed_dict — §0.1 step 9).
+    """
+    sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in batch.items()
+    }
+
+
+def epoch_batches(
+    n: int, batch_size: int, *, seed: int, epoch: int, drop_remainder: bool = True
+) -> Iterator[np.ndarray]:
+    """Deterministic shuffled index batches for one epoch (all hosts agree)."""
+    rng = np.random.Generator(np.random.Philox(key=[seed, epoch]))
+    perm = rng.permutation(n)
+    end = (n // batch_size) * batch_size if drop_remainder else n
+    for i in range(0, end, batch_size):
+        yield perm[i : i + batch_size]
+
+
+@dataclasses.dataclass
+class ShardedBatcher:
+    """Infinite deterministic iterator of device-sharded train batches.
+
+    Each process materializes only rows for its own slice of the global
+    batch; labels ride along. Normalization (uint8 -> [0,1] float32) happens
+    on device inside the step, not here.
+    """
+
+    dataset: Dataset
+    global_batch: int
+    mesh: Mesh
+    seed: int = 0
+
+    def __iter__(self) -> Iterator[dict[str, jax.Array]]:
+        n = self.dataset.train_images.shape[0]
+        n_proc, pid = jax.process_count(), jax.process_index()
+        if self.global_batch % n_proc:
+            raise ValueError("global batch must divide evenly across processes")
+        if self.global_batch > n:
+            raise ValueError(
+                f"global batch {self.global_batch} exceeds dataset size {n}: "
+                "an epoch yields zero batches"
+            )
+        local = self.global_batch // n_proc
+        epoch = 0
+        while True:
+            for idx in epoch_batches(
+                n, self.global_batch, seed=self.seed, epoch=epoch
+            ):
+                mine = idx[pid * local : (pid + 1) * local]
+                yield shard_batch(
+                    {
+                        "image": self.dataset.train_images[mine],
+                        "label": self.dataset.train_labels[mine],
+                    },
+                    self.mesh,
+                )
+            epoch += 1
+
+
+class DeviceDataset:
+    """Whole dataset in HBM; sampling is part of the compiled step.
+
+    `sample(rngkey)` is meant to be called INSIDE jit: it draws a with-
+    replacement batch via on-device RNG, so step latency has no host
+    component at all. Images stay uint8 in HBM (4x less capacity/bandwidth
+    than f32) and are normalized after the gather, on the sharded batch.
+    """
+
+    def __init__(self, dataset: Dataset, mesh: Mesh):
+        self.mesh = mesh
+        self.n = dataset.train_images.shape[0]
+        rep = NamedSharding(mesh, P())  # replicated: gather needs all rows
+        self.images = jax.device_put(dataset.train_images, rep)
+        self.labels = jax.device_put(dataset.train_labels, rep)
+
+    def sample(self, key: jax.Array, batch: int) -> dict[str, jax.Array]:
+        idx = jax.random.randint(key, (batch,), 0, self.n)
+        sharded = batch_sharding(self.mesh)
+        img = jax.lax.with_sharding_constraint(jnp.take(self.images, idx, 0), sharded)
+        lab = jax.lax.with_sharding_constraint(jnp.take(self.labels, idx, 0), sharded)
+        return {"image": img, "label": lab}

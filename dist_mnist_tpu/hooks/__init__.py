@@ -1,0 +1,33 @@
+"""Hook lifecycle — the SessionRunHook system, functional.
+
+Replaces SURVEY.md §2.4 row 18 (basic_session_run_hooks.py). Same lifecycle
+shape (begin / before-step / after-step / end), but hooks receive the step's
+returned metrics dict instead of injecting fetches into a feed/fetch merge
+(there is no session to merge into — the step is one compiled program).
+"""
+
+from dist_mnist_tpu.hooks.base import Hook
+from dist_mnist_tpu.hooks.builtin import (
+    StopAtStepHook,
+    StepCounterHook,
+    LoggingHook,
+    NaNGuardHook,
+    NanLossError,
+    CheckpointHook,
+    SummaryHook,
+    ProfilerHook,
+    EvalHook,
+)
+
+__all__ = [
+    "Hook",
+    "StopAtStepHook",
+    "StepCounterHook",
+    "LoggingHook",
+    "NaNGuardHook",
+    "NanLossError",
+    "CheckpointHook",
+    "SummaryHook",
+    "ProfilerHook",
+    "EvalHook",
+]

@@ -1,0 +1,63 @@
+"""Hook protocol (SessionRunHook analogue, SURVEY.md §2.4 row 18).
+
+Lifecycle, in loop order (train/loop.py):
+  begin(loop)                    — once, before the first step; the hook may
+                                   keep the loop handle to request_stop()
+                                   (≙ begin + after_create_session)
+  before_step(step)              — step is the int about to execute
+  after_step(step, state, out)   — `out` is the step's metrics dict of
+                                   device scalars; calling float() on one
+                                   syncs the device — hooks should do so
+                                   only at their cadence to keep dispatch
+                                   async (the analogue of not adding fetches
+                                   to every run)
+  end(state)                     — once, after the last step or stop request
+"""
+
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from dist_mnist_tpu.train.loop import TrainLoop
+
+
+class Hook:
+    def begin(self, loop: "TrainLoop") -> None:
+        pass
+
+    def before_step(self, step: int) -> None:
+        pass
+
+    def after_step(self, step: int, state, outputs: dict[str, Any]) -> None:
+        pass
+
+    def end(self, state) -> None:
+        pass
+
+
+class EverySteps:
+    """Cadence helper ≙ SecondOrStepTimer (basic_session_run_hooks.py:86):
+    triggers on a step multiple and/or a wall-clock interval."""
+
+    def __init__(self, every_steps: int | None = None,
+                 every_secs: float | None = None):
+        if every_steps is None and every_secs is None:
+            raise ValueError("need every_steps or every_secs")
+        self.every_steps = every_steps
+        self.every_secs = every_secs
+        self._last_time = time.monotonic()
+
+    def should_trigger(self, step: int) -> bool:
+        if self.every_steps is not None and step % self.every_steps == 0:
+            return True
+        if (
+            self.every_secs is not None
+            and time.monotonic() - self._last_time >= self.every_secs
+        ):
+            return True
+        return False
+
+    def mark(self) -> None:
+        self._last_time = time.monotonic()

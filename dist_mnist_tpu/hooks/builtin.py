@@ -1,0 +1,234 @@
+"""Built-in hooks, each mapped to its reference counterpart
+(basic_session_run_hooks.py — SURVEY.md §2.4 row 18)."""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+
+import jax
+
+from dist_mnist_tpu.hooks.base import Hook, EverySteps
+
+log = logging.getLogger(__name__)
+
+
+class NanLossError(RuntimeError):
+    """≙ NanLossDuringTrainingError raised by NanTensorHook (:761)."""
+
+
+class StopAtStepHook(Hook):
+    """≙ StopAtStepHook (:393-453): stop at last_step or after num_steps."""
+
+    def __init__(self, num_steps: int | None = None, last_step: int | None = None):
+        if (num_steps is None) == (last_step is None):
+            raise ValueError("exactly one of num_steps / last_step")
+        self._num_steps = num_steps
+        self._last_step = last_step
+
+    def begin(self, loop):
+        self._loop = loop
+        if self._last_step is None:
+            self._last_step = loop.initial_step + self._num_steps
+        if loop.initial_step >= self._last_step:
+            # restored at/past the limit: exit without training an extra step
+            loop.request_stop("already at last step")
+
+    def after_step(self, step, state, outputs):
+        if step >= self._last_step:
+            self._loop.request_stop("reached last step")
+
+
+class StepCounterHook(Hook):
+    """≙ StepCounterHook (:673-750): periodic steps/sec (+ examples/sec when
+    batch size is known) — the BASELINE.md metric."""
+
+    def __init__(self, every_steps: int = 100, batch_size: int | None = None,
+                 writer=None):
+        self._timer = EverySteps(every_steps=every_steps)
+        self._batch = batch_size
+        self._writer = writer
+        self._last_step = None
+        self._last_time = None
+        self.last_rate = None  # exposed for bench harnesses
+
+    def begin(self, loop):
+        self._last_step = loop.initial_step
+        self._last_time = time.monotonic()
+
+    def after_step(self, step, state, outputs):
+        if not self._timer.should_trigger(step):
+            return
+        now = time.monotonic()
+        rate = (step - self._last_step) / max(now - self._last_time, 1e-9)
+        self.last_rate = rate
+        self._last_step, self._last_time = step, now
+        self._timer.mark()
+        msg = f"step {step}: {rate:.1f} steps/sec"
+        if self._batch:
+            msg += f", {rate * self._batch:.0f} examples/sec"
+        log.info(msg)
+        if self._writer:
+            self._writer.scalar("steps_per_sec", rate, step)
+
+
+class LoggingHook(Hook):
+    """≙ LoggingTensorHook (:169): periodic metric prints. Syncs device
+    scalars only at its cadence."""
+
+    def __init__(self, every_steps: int = 100, keys: tuple[str, ...] | None = None):
+        self._timer = EverySteps(every_steps=every_steps)
+        self._keys = keys
+
+    def after_step(self, step, state, outputs):
+        if not self._timer.should_trigger(step):
+            return
+        self._timer.mark()
+        keys = self._keys or outputs.keys()
+        parts = [f"{k}={float(outputs[k]):.4f}" for k in keys if k in outputs]
+        log.info("step %d: %s", step, ", ".join(parts))
+
+
+class NaNGuardHook(Hook):
+    """≙ NanTensorHook (:761): abort (or just warn) on non-finite loss.
+
+    The reference fetched the loss every step; syncing every step would
+    serialize dispatch, so the default cadence is 25 — set 1 for parity.
+    """
+
+    def __init__(self, key: str = "loss", every_steps: int = 25,
+                 fail_on_nan: bool = True):
+        self._key = key
+        self._timer = EverySteps(every_steps=every_steps)
+        self._fail = fail_on_nan
+
+    def begin(self, loop):
+        self._loop = loop
+
+    def after_step(self, step, state, outputs):
+        if self._key not in outputs or not self._timer.should_trigger(step):
+            return
+        self._timer.mark()
+        val = float(outputs[self._key])
+        if math.isfinite(val):
+            return
+        if self._fail:
+            raise NanLossError(f"{self._key} is {val} at step {step}")
+        log.warning("%s is %s at step %d; stopping", self._key, val, step)
+        self._loop.request_stop("non-finite loss")
+
+
+class CheckpointHook(Hook):
+    """≙ CheckpointSaverHook (:524-670): save at begin (save-on-create,
+    :585-602), on a step/secs cadence (:607-616), and at end (:618-623)."""
+
+    def __init__(self, manager, every_steps: int | None = None,
+                 every_secs: float | None = 600.0):
+        self._mgr = manager
+        self._timer = EverySteps(every_steps=every_steps, every_secs=every_secs)
+
+    def begin(self, loop):
+        self._loop = loop
+        # save-on-create (:585-602): guarantees a restore point exists before
+        # the first cadence trigger; a restored state dedupes by step.
+        self._mgr.save(loop.state)
+
+    def after_step(self, step, state, outputs):
+        if self._timer.should_trigger(step):
+            self._timer.mark()
+            self._mgr.save(state)
+
+    def end(self, state):
+        self._mgr.save(state)
+        self._mgr.wait()
+
+
+class SummaryHook(Hook):
+    """≙ SummarySaverHook (:793) + SummaryWriterCache: periodic scalar
+    summaries to a metric writer (obs/writers.py)."""
+
+    def __init__(self, writer, every_steps: int = 100):
+        self._writer = writer
+        self._timer = EverySteps(every_steps=every_steps)
+
+    def after_step(self, step, state, outputs):
+        if not self._timer.should_trigger(step):
+            return
+        self._timer.mark()
+        for k, v in outputs.items():
+            try:
+                self._writer.scalar(k, float(v), step)
+            except (TypeError, ValueError):
+                pass
+
+    def end(self, state):
+        self._writer.flush()
+
+
+class ProfilerHook(Hook):
+    """≙ ProfilerHook (:1013-1095): Chrome-trace a window of steps. Uses
+    jax.profiler (XLA + ICI in one TensorBoard trace) instead of
+    RunMetadata/Timeline."""
+
+    def __init__(self, logdir: str, start_step: int = 10, num_steps: int = 3):
+        self._logdir = logdir
+        self._start = start_step
+        self._stop = start_step + num_steps
+        self._active = False
+
+    def before_step(self, step):
+        if step == self._start and not self._active:
+            jax.profiler.start_trace(self._logdir)
+            self._active = True
+
+    def after_step(self, step, state, outputs):
+        # after_step sees the post-increment step: steps _start.._stop-1
+        # (num_steps of them) run inside the trace window
+        if self._active and step >= self._stop:
+            jax.block_until_ready(outputs.get("loss"))
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profile for steps [%d, %d) -> %s",
+                     self._start, self._stop, self._logdir)
+
+    def end(self, state):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+class EvalHook(Hook):
+    """Periodic full-test-set eval (the reference did this ad hoc at the end
+    of the train loop — §0.1 step 9; as a hook it also serves the 'validation
+    while training' role MonitoredTrainingSession left to summaries)."""
+
+    def __init__(self, eval_fn, every_steps: int = 1000, writer=None,
+                 name: str = "test"):
+        self._eval = eval_fn
+        self._timer = EverySteps(every_steps=every_steps)
+        self._writer = writer
+        self._name = name
+        self.last_result: dict | None = None
+        self._last_eval_step: int | None = None
+
+    def _run(self, step, state):
+        res = self._eval(state)
+        self.last_result = res
+        self._last_eval_step = step
+        log.info("%s eval @ step %d: loss=%.4f acc=%.4f",
+                 self._name, step, res["loss"], res["accuracy"])
+        if self._writer:
+            self._writer.scalar(f"{self._name}/loss", res["loss"], step)
+            self._writer.scalar(f"{self._name}/accuracy", res["accuracy"], step)
+
+    def after_step(self, step, state, outputs):
+        if self._timer.should_trigger(step):
+            self._timer.mark()
+            self._run(step, state)
+
+    def end(self, state):
+        step = -1 if state is None else int(state.step)
+        if step == self._last_eval_step:
+            return  # final step landed on the cadence; don't eval twice
+        self._run(step, state)

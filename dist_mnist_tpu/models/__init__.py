@@ -1,0 +1,17 @@
+"""Model zoo for the benchmark ladder (BASELINE.md configs 1-5):
+
+- `mlp.MLP` — the reference driver's exact 2-layer MLP geometry
+  (SURVEY.md §0.1 step 5).
+- `lenet.LeNet5` — the "original dist config" CNN tower.
+- `resnet.ResNet20` — CIFAR-10 residual net (8-way DP config).
+- `vit.ViTTiny` — attention-path stretch config (pod slice).
+
+All models follow the functional contract in `base.Model`: f32 params,
+optional bfloat16 compute, mutable state (e.g. BN running stats) threaded
+explicitly.
+"""
+
+from dist_mnist_tpu.models.base import Model
+from dist_mnist_tpu.models.registry import get_model, MODELS
+
+__all__ = ["Model", "get_model", "MODELS"]

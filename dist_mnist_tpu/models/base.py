@@ -1,0 +1,44 @@
+"""The model contract.
+
+The reference built models as side-effectful graph construction against
+implicit collections (`tf.Variable` placed by replica_device_setter —
+SURVEY.md §0.1 step 5, §2.2 row 5). Here a model is two pure functions over
+explicit pytrees; placement is a separate concern (parallel/sharding.py
+assigns PartitionSpecs to the returned params by path).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+import jax
+
+Params = dict
+State = dict  # mutable model state (BN running stats); {} for stateless models
+
+
+class Model(Protocol):
+    """Functional model: `init` builds pytrees, `apply` is pure.
+
+    - ``init(rng, sample_input) -> (params, state)``; sample_input is a
+      host/abstract batch used only for shapes.
+    - ``apply(params, state, x, *, train, rng) -> (logits, new_state)``;
+      ``rng`` may be None when the model has no stochastic layers or
+      ``train=False``.
+    - ``compute_dtype`` — activations dtype (bfloat16 on TPU by default);
+      params stay float32 (master weights).
+    """
+
+    compute_dtype: jax.numpy.dtype
+
+    def init(self, rng: jax.Array, sample_input) -> tuple[Params, State]: ...
+
+    def apply(
+        self,
+        params: Params,
+        state: State,
+        x: jax.Array,
+        *,
+        train: bool = False,
+        rng: jax.Array | None = None,
+    ) -> tuple[jax.Array, State]: ...

@@ -1,0 +1,48 @@
+"""LeNet-5 CNN — the "original dist config" tower (SURVEY.md §0.1 step 5):
+conv5x5x32 → maxpool → conv5x5x64 → maxpool → fc512 → dropout → fc10.
+
+This is the flagship benchmark model (BASELINE.md north-star metric is
+"MNIST CNN steps/sec/chip"). Compute defaults to bfloat16: both convs and
+the fc512 GEMM hit the MXU at double rate while params/logits stay f32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.ops import nn
+
+
+@dataclasses.dataclass(frozen=True)
+class LeNet5:
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def init(self, rng, sample_input):
+        h, w, c = (int(d) for d in sample_input.shape[1:])
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        fc_in = (h // 4) * (w // 4) * 64  # two SAME convs + two 2x2 pools
+        params = {
+            "conv1": nn.init_conv(k1, 5, 5, c, 32),
+            "conv2": nn.init_conv(k2, 5, 5, 32, 64),
+            "fc1": nn.init_dense(k3, fc_in, 512),
+            "fc2": nn.init_dense(k4, 512, self.num_classes),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        x = x.astype(self.compute_dtype)
+        x = nn.relu(nn.conv2d(params["conv1"], x))
+        x = nn.max_pool(x, 2)
+        x = nn.relu(nn.conv2d(params["conv2"], x))
+        x = nn.max_pool(x, 2)
+        x = nn.flatten(x)
+        x = nn.relu(nn.dense(params["fc1"], x))
+        if train and rng is not None:
+            x = nn.dropout(rng, x, self.dropout_rate, train=True)
+        logits = nn.dense(params["fc2"], x)
+        return logits.astype(jnp.float32), state

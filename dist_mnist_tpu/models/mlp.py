@@ -1,0 +1,41 @@
+"""2-layer MLP — the reference driver's exact model (SURVEY.md §0.1 step 5).
+
+Geometry parity: ``hid_w [784, hidden]``, ``sm_w [hidden, 10]``, truncated-
+normal init with stddev 1/sqrt(fan_in), ReLU hidden layer. The reference
+applied an explicit softmax and clipped-log loss; we emit raw logits and pair
+the model with `ops.losses.clipped_softmax_cross_entropy` for bit-level
+comparability (the softmax lives in the loss, where XLA fuses it anyway).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.ops import nn
+
+
+@dataclasses.dataclass(frozen=True)
+class MLP:
+    hidden_units: int = 100  # reference flag default (§0.1 flag table)
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.float32  # tiny model: MXU gain ≈ 0, keep f32
+
+    def init(self, rng, sample_input):
+        in_dim = 1
+        for d in sample_input.shape[1:]:
+            in_dim *= int(d)
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "hid": nn.init_dense(k1, in_dim, self.hidden_units),
+            "sm": nn.init_dense(k2, self.hidden_units, self.num_classes),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        x = nn.flatten(x).astype(self.compute_dtype)
+        h = nn.relu(nn.dense(params["hid"], x))
+        logits = nn.dense(params["sm"], h)
+        return logits.astype(jnp.float32), state

@@ -1,0 +1,21 @@
+"""Model registry: name -> constructor, mirroring the config ladder."""
+
+from __future__ import annotations
+
+from dist_mnist_tpu.models.lenet import LeNet5
+from dist_mnist_tpu.models.mlp import MLP
+from dist_mnist_tpu.models.resnet import ResNet20
+from dist_mnist_tpu.models.vit import ViTTiny
+
+MODELS = {
+    "mlp": MLP,
+    "lenet5": LeNet5,
+    "resnet20": ResNet20,
+    "vit_tiny": ViTTiny,
+}
+
+
+def get_model(name: str, **overrides):
+    if name not in MODELS:
+        raise KeyError(f"unknown model {name!r}; have {sorted(MODELS)}")
+    return MODELS[name](**overrides)

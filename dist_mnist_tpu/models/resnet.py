@@ -1,0 +1,88 @@
+"""ResNet-20 for CIFAR-10 (BASELINE.md config 4: 8-way DP stress of
+conv + all-reduce).
+
+Classic CIFAR ResNet (He et al. 2016): 3 stages × 3 basic blocks, widths
+16/32/64, stride-2 at stage entry, identity shortcuts with 1x1 projection on
+downsample, batch norm + ReLU, global average pool, fc10. Batch norm runs
+synchronized across the `data` mesh axis for free: the batch dim is sharded,
+so XLA turns the batch-mean into an ICI all-reduce (see ops/nn.batch_norm).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.ops import nn
+
+
+def _init_block(key, cin, cout, stride):
+    k1, k2, k3 = jax.random.split(key, 3)
+    conv1 = nn.init_conv(k1, 3, 3, cin, cout, init=nn.he_normal)
+    conv2 = nn.init_conv(k2, 3, 3, cout, cout, init=nn.he_normal)
+    bn1_p, bn1_s = nn.init_batch_norm(cout)
+    bn2_p, bn2_s = nn.init_batch_norm(cout)
+    params = {"conv1": conv1, "conv2": conv2, "bn1": bn1_p, "bn2": bn2_p}
+    state = {"bn1": bn1_s, "bn2": bn2_s}
+    if stride != 1 or cin != cout:
+        params["proj"] = nn.init_conv(k3, 1, 1, cin, cout, init=nn.he_normal)
+    return params, state
+
+
+def _apply_block(p, s, x, stride, train):
+    y = nn.conv2d(p["conv1"], x, stride=stride)
+    y, s1 = nn.batch_norm(p["bn1"], s["bn1"], y, train=train)
+    y = nn.relu(y)
+    y = nn.conv2d(p["conv2"], y)
+    y, s2 = nn.batch_norm(p["bn2"], s["bn2"], y, train=train)
+    shortcut = nn.conv2d(p["proj"], x, stride=stride) if "proj" in p else x
+    return nn.relu(y + shortcut), {"bn1": s1, "bn2": s2}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNet20:
+    num_classes: int = 10
+    widths: tuple[int, ...] = (16, 32, 64)
+    blocks_per_stage: int = 3
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def init(self, rng, sample_input):
+        c = int(sample_input.shape[-1])
+        keys = jax.random.split(rng, 2 + len(self.widths) * self.blocks_per_stage)
+        params: dict = {"stem": nn.init_conv(keys[0], 3, 3, c, self.widths[0],
+                                             init=nn.he_normal)}
+        bn_p, bn_s = nn.init_batch_norm(self.widths[0])
+        params["stem_bn"] = bn_p
+        state: dict = {"stem_bn": bn_s}
+        cin = self.widths[0]
+        ki = 1
+        for si, w in enumerate(self.widths):
+            for bi in range(self.blocks_per_stage):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                bp, bs = _init_block(keys[ki], cin, w, stride)
+                params[f"s{si}b{bi}"] = bp
+                state[f"s{si}b{bi}"] = bs
+                cin = w
+                ki += 1
+        params["head"] = nn.init_dense(keys[ki], cin, self.num_classes,
+                                       init=nn.xavier_uniform)
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        x = x.astype(self.compute_dtype)
+        x = nn.conv2d(params["stem"], x)
+        x, stem_s = nn.batch_norm(params["stem_bn"], state["stem_bn"], x, train=train)
+        x = nn.relu(x)
+        new_state = {"stem_bn": stem_s}
+        for si in range(len(self.widths)):
+            for bi in range(self.blocks_per_stage):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                name = f"s{si}b{bi}"
+                x, new_state[name] = _apply_block(
+                    params[name], state[name], x, stride, train
+                )
+        x = nn.global_avg_pool(x)
+        logits = nn.dense(params["head"], x)
+        return logits.astype(jnp.float32), new_state

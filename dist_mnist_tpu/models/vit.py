@@ -1,0 +1,104 @@
+"""ViT-Tiny for CIFAR-10 (BASELINE.md config 5 — the attention-path stretch
+config for pod slices).
+
+Standard ViT-Ti geometry (dim 192, depth 12, heads 3), 4x4 patches so a
+32x32 image is a 64-token sequence, learned position embeddings, CLS token,
+pre-LN blocks. The attention inner loop is swappable: the default XLA
+einsum path (ops/nn.dot_product_attention), the Pallas flash kernel
+(ops/pallas/flash_attention.py), or ring attention over the `seq` mesh axis
+(parallel/ring_attention.py) — selected by `attention_impl`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.ops import nn
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTTiny:
+    num_classes: int = 10
+    patch: int = 4
+    dim: int = 192
+    depth: int = 12
+    heads: int = 3
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.1
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "xla"  # "xla" | "flash" | "ring"
+
+    def init(self, rng, sample_input):
+        h, w, c = (int(d) for d in sample_input.shape[1:])
+        n_tokens = (h // self.patch) * (w // self.patch) + 1  # + CLS
+        keys = jax.random.split(rng, 4 + self.depth)
+        d = self.dim
+        params: dict = {
+            "patch": nn.init_conv(keys[0], self.patch, self.patch,
+                                  c, d, init=nn.xavier_uniform),
+            "pos": 0.02 * jax.random.normal(keys[1], (1, n_tokens, d)),
+            "cls": jnp.zeros((1, 1, d)),
+            "head": nn.init_dense(keys[2], d, self.num_classes,
+                                  init=nn.xavier_uniform),
+            "final_ln": nn.init_layer_norm(d),
+        }
+        for i in range(self.depth):
+            k1, k2, k3 = jax.random.split(keys[3 + i], 3)
+            params[f"block{i}"] = {
+                "ln1": nn.init_layer_norm(d),
+                "attn": nn.init_attention(k1, d, self.heads),
+                "ln2": nn.init_layer_norm(d),
+                "mlp_in": nn.init_dense(k2, d, d * self.mlp_ratio,
+                                        init=nn.xavier_uniform),
+                "mlp_out": nn.init_dense(k3, d * self.mlp_ratio, d,
+                                         init=nn.xavier_uniform),
+            }
+        return params, {}
+
+    def _attention(self, p, x):
+        if self.attention_impl == "xla":
+            return nn.multi_head_attention(p, x, self.heads)
+        b, s, d = x.shape
+        h = self.heads
+        qkv = nn.dense(p["qkv"], x).reshape(b, s, 3, h, d // h)
+        q, k, v = jnp.moveaxis(qkv, 2, 0)
+        if self.attention_impl == "flash":
+            from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v)
+        elif self.attention_impl == "ring":
+            from dist_mnist_tpu.parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v)
+        else:
+            raise ValueError(
+                f"unknown attention_impl {self.attention_impl!r}; "
+                "use 'xla' | 'flash' | 'ring'"
+            )
+        return nn.dense(p["out"], out.reshape(b, s, d))
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        x = x.astype(self.compute_dtype)
+        x = nn.conv2d(params["patch"], x, stride=self.patch, padding="VALID")
+        b, ph, pw, d = x.shape
+        x = x.reshape(b, ph * pw, d)
+        cls = jnp.broadcast_to(params["cls"].astype(x.dtype), (b, 1, d))
+        x = jnp.concatenate([cls, x], axis=1)
+        x = x + params["pos"].astype(x.dtype)
+        if train and rng is not None:
+            rngs = jax.random.split(rng, self.depth)
+        for i in range(self.depth):
+            p = params[f"block{i}"]
+            y = nn.layer_norm(p["ln1"], x)
+            x = x + self._attention(p["attn"], y)
+            y = nn.layer_norm(p["ln2"], x)
+            y = nn.gelu(nn.dense(p["mlp_in"], y))
+            if train and rng is not None:
+                y = nn.dropout(rngs[i], y, self.dropout_rate, train=True)
+            x = x + nn.dense(p["mlp_out"], y)
+        x = nn.layer_norm(params["final_ln"], x)
+        logits = nn.dense(params["head"], x[:, 0])
+        return logits.astype(jnp.float32), state

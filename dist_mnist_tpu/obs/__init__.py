@@ -1,0 +1,20 @@
+"""Observability: metric writers (tf.summary / SummaryWriterCache analogue,
+SURVEY.md §5.5)."""
+
+from dist_mnist_tpu.obs.writers import (
+    MetricWriter,
+    StdoutWriter,
+    CsvWriter,
+    TensorBoardWriter,
+    MultiWriter,
+    make_default_writer,
+)
+
+__all__ = [
+    "MetricWriter",
+    "StdoutWriter",
+    "CsvWriter",
+    "TensorBoardWriter",
+    "MultiWriter",
+    "make_default_writer",
+]

@@ -1,0 +1,95 @@
+"""Scalar metric writers.
+
+Replaces the reference's summary path (SURVEY.md §5.5: merged summary op ->
+SummarySaverHook -> SummaryWriterCache -> event files). Writers here are
+plain host-side objects fed by hooks; TensorBoard output goes through
+`clu.metric_writers` when available. Only the chief process writes
+(mirroring chief-only summary hooks, monitored_session.py:517-532).
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+from pathlib import Path
+from typing import Protocol
+
+log = logging.getLogger(__name__)
+
+
+class MetricWriter(Protocol):
+    def scalar(self, tag: str, value: float, step: int) -> None: ...
+
+    def flush(self) -> None: ...
+
+
+class StdoutWriter:
+    def scalar(self, tag, value, step):
+        log.info("[metric] step=%d %s=%.6g", step, tag, value)
+
+    def flush(self):
+        pass
+
+
+class CsvWriter:
+    """One CSV per run: step,tag,value — trivially parseable by benches."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "a", newline="")
+        self._writer = csv.writer(self._fh)
+        if self._fh.tell() == 0:
+            self._writer.writerow(["step", "tag", "value"])
+
+    def scalar(self, tag, value, step):
+        self._writer.writerow([step, tag, value])
+
+    def flush(self):
+        self._fh.flush()
+
+
+class TensorBoardWriter:
+    """clu-backed TensorBoard event files; degrades to a warning if clu is
+    unavailable (nothing in the framework hard-depends on it)."""
+
+    def __init__(self, logdir: str | Path):
+        try:
+            from clu import metric_writers
+
+            self._w = metric_writers.SummaryWriter(str(logdir))
+        except Exception:
+            log.warning("clu/tensorboard unavailable; TensorBoardWriter is a no-op")
+            self._w = None
+
+    def scalar(self, tag, value, step):
+        if self._w is not None:
+            self._w.write_scalars(step, {tag: value})
+
+    def flush(self):
+        if self._w is not None:
+            self._w.flush()
+
+
+class MultiWriter:
+    def __init__(self, *writers: MetricWriter):
+        self.writers = writers
+
+    def scalar(self, tag, value, step):
+        for w in self.writers:
+            w.scalar(tag, value, step)
+
+    def flush(self):
+        for w in self.writers:
+            w.flush()
+
+
+def make_default_writer(logdir: str | Path | None, *, chief: bool = True):
+    """Stdout always (chief only); CSV + TensorBoard when a logdir is given."""
+    if not chief:
+        return MultiWriter()
+    writers: list[MetricWriter] = [StdoutWriter()]
+    if logdir is not None:
+        writers.append(CsvWriter(Path(logdir) / "metrics.csv"))
+        writers.append(TensorBoardWriter(logdir))
+    return MultiWriter(*writers)

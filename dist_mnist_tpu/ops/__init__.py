@@ -1,0 +1,15 @@
+"""Compute ops: the layer/loss/metric library the models are built from.
+
+Replaces the reference's graph-construction layer (SURVEY.md §1 L5 —
+`tf.nn.*`, `tf.Variable`, `tf.gradients`): here a layer is an init function
+returning a params pytree plus a pure apply function; autodiff is
+`jax.grad` over the composed step. Everything is jit-traceable, static-
+shaped, and bfloat16-friendly so XLA can tile onto the MXU.
+
+`ops.pallas` holds hand-written TPU kernels for hot paths with pure-XLA
+fallbacks.
+"""
+
+from dist_mnist_tpu.ops import nn, losses, metrics
+
+__all__ = ["nn", "losses", "metrics"]

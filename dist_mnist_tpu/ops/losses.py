@@ -1,0 +1,63 @@
+"""Loss functions.
+
+Includes bit-comparable parity with the reference driver's clipped
+cross-entropy (SURVEY.md §0.1 step 5:
+``loss = -Σ y_·log(clip(softmax(logits), 1e-10, 1.0))``) alongside the
+numerically-sound log-softmax form used by default.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def clipped_softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    reduction: str = "mean",
+) -> jax.Array:
+    """The reference's exact loss: explicit softmax, clip to [1e-10, 1], -Σ.
+
+    Kept for numeric comparability with the upstream MLP config. ``labels``
+    are integer class ids (one-hot happens here, matching
+    ``read_data_sets(one_hot=True)`` feeding ``y_``).
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    logp = jnp.log(jnp.clip(probs, 1e-10, 1.0))
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    per_example = -jnp.sum(onehot * logp, axis=-1)
+    return _reduce(per_example, reduction)
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    *,
+    reduction: str = "mean",
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Stable log-softmax cross-entropy (default loss for all configs)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    n = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, n, dtype=jnp.float32)
+    if label_smoothing:
+        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / n
+    per_example = -jnp.sum(onehot * logp, axis=-1)
+    return _reduce(per_example, reduction)
+
+
+def l2_regularization(params, scale: float) -> jax.Array:
+    leaves = jax.tree.leaves(params)
+    return scale * sum(jnp.sum(jnp.square(a.astype(jnp.float32))) for a in leaves)
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":  # the reference reduced with -Σ over the batch too
+        return jnp.sum(x)
+    if reduction == "none":
+        return x
+    raise ValueError(f"unknown reduction {reduction!r}")

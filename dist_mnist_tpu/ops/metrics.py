@@ -1,0 +1,20 @@
+"""Metrics computed inside compiled steps (scalars come back as f32)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+
+def correct_count(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
+
+
+def topk_accuracy(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:
+    _, idx = jax.lax.top_k(logits, k)
+    hit = jnp.any(idx == labels[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))

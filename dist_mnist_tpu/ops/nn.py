@@ -1,0 +1,232 @@
+"""Functional NN layers: init fns returning param pytrees + pure apply fns.
+
+Design rules (TPU-first):
+- Params are float32; compute may run bfloat16 (`cast` at call sites) —
+  matmuls/convs then hit the MXU at full rate while master weights keep
+  f32 precision for the optimizer.
+- All shapes static; no Python control flow on traced values.
+- NHWC images, HWIO conv kernels (XLA:TPU's preferred layouts).
+
+Initializers replicate the reference's
+`truncated_normal(stddev=1/sqrt(fan_in))` (SURVEY.md §0.1 step 5) so the MLP
+config is numerically comparable.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict
+
+
+# ---------------------------------------------------------------------------
+# initializers
+
+
+def truncated_normal(key, shape, stddev: float, dtype=jnp.float32):
+    """2-sigma truncated normal — same family as tf.truncated_normal used by
+    the reference driver (§0.1 step 5)."""
+    return stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+
+
+def fan_in_trunc_normal(key, shape, dtype=jnp.float32):
+    fan_in = math.prod(shape[:-1])
+    return truncated_normal(key, shape, 1.0 / (fan_in**0.5), dtype)
+
+
+def he_normal(key, shape, dtype=jnp.float32):
+    fan_in = math.prod(shape[:-1])
+    return jax.random.normal(key, shape, dtype) * (2.0 / fan_in) ** 0.5
+
+
+def xavier_uniform(key, shape, dtype=jnp.float32):
+    fan_in = math.prod(shape[:-1])
+    fan_out = int(shape[-1])
+    limit = (6.0 / (fan_in + fan_out)) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+# ---------------------------------------------------------------------------
+# dense
+
+
+def init_dense(key, in_dim: int, out_dim: int, *, init=fan_in_trunc_normal) -> Params:
+    kw, _ = jax.random.split(key)
+    return {"w": init(kw, (in_dim, out_dim)), "b": jnp.zeros((out_dim,))}
+
+
+def dense(p: Params, x: jax.Array) -> jax.Array:
+    return x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+
+
+def init_conv(
+    key, kh: int, kw: int, cin: int, cout: int, *, init=fan_in_trunc_normal
+) -> Params:
+    k, _ = jax.random.split(key)
+    return {"w": init(k, (kh, kw, cin, cout)), "b": jnp.zeros((cout,))}
+
+
+def conv2d(
+    p: Params, x: jax.Array, *, stride: int = 1, padding: str = "SAME"
+) -> jax.Array:
+    y = lax.conv_general_dilated(
+        x,
+        p["w"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + p["b"].astype(x.dtype)
+
+
+def max_pool(x: jax.Array, window: int = 2, stride: int | None = None) -> jax.Array:
+    stride = stride or window
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        (1, window, window, 1),
+        (1, stride, stride, 1),
+        "VALID",
+    )
+
+
+def avg_pool(x: jax.Array, window: int = 2, stride: int | None = None) -> jax.Array:
+    stride = stride or window
+    summed = lax.reduce_window(
+        x.astype(jnp.float32),
+        0.0,
+        lax.add,
+        (1, window, window, 1),
+        (1, stride, stride, 1),
+        "VALID",
+    )
+    return (summed / (window * window)).astype(x.dtype)
+
+
+def global_avg_pool(x: jax.Array) -> jax.Array:
+    return jnp.mean(x.astype(jnp.float32), axis=(1, 2)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+
+
+def init_layer_norm(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def layer_norm(p: Params, x: jax.Array, *, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def init_batch_norm(dim: int) -> tuple[Params, Params]:
+    """Returns (params, state): state carries EMA running stats (the mutable
+    part — threaded through apply, never assigned in place)."""
+    params = {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+    state = {"mean": jnp.zeros((dim,)), "var": jnp.ones((dim,))}
+    return params, state
+
+
+def batch_norm(
+    p: Params,
+    state: Params,
+    x: jax.Array,
+    *,
+    train: bool,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+) -> tuple[jax.Array, Params]:
+    """NHWC batch norm. Under jit with the batch dim sharded over `data`,
+    the mean/var reductions become cross-replica (XLA inserts the all-reduce)
+    — i.e. synchronized BN for free, where the reference had no BN at all."""
+    xf = x.astype(jnp.float32)
+    if train:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(xf, axes)
+        var = jnp.var(xf, axes)
+        new_state = {
+            "mean": momentum * state["mean"] + (1 - momentum) * mean,
+            "var": momentum * state["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    y = (xf - mean) * lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return y.astype(x.dtype), new_state
+
+
+# ---------------------------------------------------------------------------
+# regularization / activations
+
+
+def dropout(key, x: jax.Array, rate: float, *, train: bool) -> jax.Array:
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+relu = jax.nn.relu
+gelu = jax.nn.gelu
+softmax = jax.nn.softmax
+log_softmax = jax.nn.log_softmax
+
+
+# ---------------------------------------------------------------------------
+# attention (used by ViT; the sharded/ring variants live in parallel/)
+
+
+def init_attention(key, dim: int, num_heads: int) -> Params:
+    ks = jax.random.split(key, 4)
+    init = xavier_uniform
+    del num_heads  # static; passed to multi_head_attention, not stored in params
+    return {
+        "qkv": {"w": init(ks[0], (dim, 3 * dim)), "b": jnp.zeros((3 * dim,))},
+        "out": {"w": init(ks[1], (dim, dim)), "b": jnp.zeros((dim,))},
+    }
+
+
+def multi_head_attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
+    """[B, S, D] self-attention. Kept simple/fused-friendly; the Pallas flash
+    kernel (ops/pallas) and ring attention (parallel/ring_attention.py) are
+    drop-in replacements for the inner softmax(QK^T)V."""
+    b, s, d = x.shape
+    h = num_heads
+    qkv = dense(p["qkv"], x).reshape(b, s, 3, h, d // h)
+    q, k, v = jnp.moveaxis(qkv, 2, 0)  # each [B, S, H, Dh]
+    out = dot_product_attention(q, k, v)
+    return dense(p["out"], out.reshape(b, s, d))
+
+
+def dot_product_attention(q, k, v) -> jax.Array:
+    """[B, S, H, Dh] -> [B, S, H, Dh]; accumulation in f32 for stability."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def flatten(x: jax.Array) -> jax.Array:
+    return x.reshape(x.shape[0], -1)
+
+
+def cast(tree, dtype):
+    """Cast floating leaves of a pytree (compute-dtype policy entry point)."""
+    return jax.tree.map(
+        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        tree,
+    )

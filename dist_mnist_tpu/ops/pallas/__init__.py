@@ -1,0 +1,13 @@
+"""Hand-written Pallas TPU kernels for hot ops.
+
+The reference's hand-written native layer was op kernels + the gRPC wire
+path (SURVEY.md §2.5); here the native layer that matters is what XLA does
+NOT already fuse well. Each kernel ships with an interpret-mode path so the
+CPU test mesh exercises the same code, and a pure-XLA reference
+implementation it is tested against.
+"""
+
+from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
+from dist_mnist_tpu.ops.pallas.fused_adam import fused_adam_update
+
+__all__ = ["flash_attention", "fused_adam_update"]

@@ -1,0 +1,96 @@
+"""Fused attention Pallas kernel (flash-style: no HBM score matrix).
+
+The XLA einsum path (ops/nn.dot_product_attention) materializes the
+[B,H,S,S] score tensor in HBM for long S; this kernel tiles queries over a
+grid and keeps each [block_q, S] score tile in VMEM — scores never touch
+HBM. Softmax is computed per tile in f32 (exact, since the full key axis is
+resident per tile); the MXU sees two GEMMs per tile.
+
+Layout: grid = (B*H, S/block_q); per program: q tile [block_q, D], full K/V
+[S, D] for that (batch, head). VMEM budget at default block_q=128, S<=8192,
+D<=128, bf16: ~2 MB score tile + ~4 MB K/V — inside the ~16 MB/core VMEM.
+For longer S, shard the sequence first (parallel/ring_attention.py) and let
+each device run this kernel on its local block.
+
+`interpret=True` (auto on non-TPU backends) runs the same kernel under the
+Pallas interpreter so the CPU test mesh covers it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, s_real: int):
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    k = k_ref[0]  # [S_pad, D]
+    v = v_ref[0]
+    logits = jax.lax.dot_general(
+        q, k.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, S_pad]
+    # mask key padding (S was rounded up to the lane tile)
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(col < s_real, logits, -1e30)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def _flash_attention(q, k, v, block_q: int, interpret: bool):
+    b, s, h, d = q.shape
+    scale = d**-0.5
+    s_pad = _round_up(s, 128)
+    q_pad = _round_up(s, block_q)
+
+    def to_bh(x, length):  # [B,S,H,D] -> [B*H, length, D]
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+        return jnp.pad(x, ((0, 0), (0, length - s), (0, 0)))
+
+    qb, kb, vb = to_bh(q, q_pad), to_bh(k, s_pad), to_bh(v, s_pad)
+    grid = (b * h, q_pad // block_q)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale, s_real=s),
+        out_shape=jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(qb, kb, vb)
+    out = out[:, :s].reshape(b, h, s, d)
+    return jnp.moveaxis(out, 1, 2)  # [B,S,H,D]
+
+
+def flash_attention(q, k, v, *, block_q: int = 128,
+                    interpret: bool | None = None):
+    """[B,S,H,D] self-attention, fused in VMEM. Drop-in for
+    ops/nn.dot_product_attention (non-causal)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_attention(q, k, v, block_q=min(block_q, _round_up(q.shape[1], 8)),
+                            interpret=interpret)

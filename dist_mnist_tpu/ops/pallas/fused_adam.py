@@ -1,0 +1,69 @@
+"""Fused Adam update as a single Pallas kernel per parameter.
+
+The reference's ApplyAdam was one fused native kernel running on the PS
+(training_ops.h:ApplyAdam — SURVEY.md §2.3 row 8). XLA already fuses our
+pure-jnp Adam into a few elementwise loops; this kernel goes one step
+further and does m/v/delta in ONE pass over HBM (3 reads + 3 writes per
+element, the bandwidth floor), and is the template for richer fused
+optimizers. Selected via `optim.adam(fused=True)`; bitwise-compatible with
+the reference update rule (eps outside the sqrt).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_ROWS = 256  # 256x128 f32 block = 128 KiB per buffer in VMEM
+
+
+def _adam_kernel(lr_ref, g_ref, m_ref, v_ref, d_ref, mo_ref, vo_ref,
+                 *, b1: float, b2: float, eps: float):
+    g = g_ref[:].astype(jnp.float32)
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    mo_ref[:] = m
+    vo_ref[:] = v
+    d_ref[:] = -lr_ref[0] * m / (jnp.sqrt(v) + eps)
+
+
+@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps"))
+def fused_adam_update(grad, m, v, lr_t, *, b1: float = 0.9, b2: float = 0.999,
+                      eps: float = 1e-8):
+    """One-pass Adam slot+delta update for a single tensor.
+
+    Returns (delta, new_m, new_v); `lr_t` is the bias-corrected step size
+    (traced scalar — computed by the caller from the step count).
+    interpret-mode on non-TPU backends, so the CPU mesh runs it too.
+    """
+    shape, dtype = grad.shape, jnp.float32
+    n = math.prod(shape) if shape else 1
+    rows = max(1, math.ceil(n / _LANES))
+    pad = rows * _LANES - n
+    as2d = lambda x: jnp.pad(
+        x.astype(jnp.float32).reshape(-1), (0, pad)
+    ).reshape(rows, _LANES)
+    block_rows = min(_ROWS, rows)
+    grid = (math.ceil(rows / block_rows),)
+    tile = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((rows, _LANES), dtype)
+    delta, m2, v2 = pl.pallas_call(
+        functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps),
+        out_shape=(out_shape, out_shape, out_shape),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lr_t scalar
+            tile, tile, tile,
+        ],
+        out_specs=(tile, tile, tile),
+        interpret=jax.default_backend() != "tpu",
+    )(jnp.reshape(lr_t, (1,)).astype(jnp.float32), as2d(grad), as2d(m), as2d(v))
+    unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
+    return unflat(delta), unflat(m2), unflat(v2)

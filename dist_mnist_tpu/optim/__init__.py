@@ -1,0 +1,46 @@
+"""Optimizers, built from scratch on the gradient-transformation pattern.
+
+Replaces SURVEY.md §2.3 rows 6-8: `tf.train.Optimizer`'s
+minimize = compute_gradients + apply_gradients split (optimizer.py:463-783),
+Adam's m/v slots + beta-power non-slots (adam.py:189-231), and the fused
+native ApplyAdam kernel (training_ops.h). Here the whole update is pure
+array math inside the jit-compiled step — XLA fuses it into a handful of
+elementwise kernels over each param, which *is* the training_ops.h fusion,
+compiler-generated.
+
+SyncReplicasOptimizer's `replicas_to_aggregate` semantics live in
+`sync.py` as gradient accumulation (see that module for the exact mapping
+and its documented divergence from the PS token-queue protocol).
+"""
+
+from dist_mnist_tpu.optim.base import (
+    Optimizer,
+    OptimizerDef,
+    apply_updates,
+    chain,
+    clip_by_global_norm,
+    scale,
+    add_decayed_weights,
+    global_norm,
+)
+from dist_mnist_tpu.optim.adam import adam, adamw
+from dist_mnist_tpu.optim.sgd import sgd, momentum
+from dist_mnist_tpu.optim.sync import gradient_accumulation
+from dist_mnist_tpu.optim import schedules
+
+__all__ = [
+    "Optimizer",
+    "OptimizerDef",
+    "apply_updates",
+    "chain",
+    "clip_by_global_norm",
+    "scale",
+    "add_decayed_weights",
+    "global_norm",
+    "adam",
+    "adamw",
+    "sgd",
+    "momentum",
+    "gradient_accumulation",
+    "schedules",
+]

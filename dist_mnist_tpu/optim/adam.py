@@ -1,0 +1,98 @@
+"""Adam with the reference's exact semantics.
+
+Parity target: `tf.train.AdamOptimizer` (SURVEY.md §2.3 row 7) — slots m/v
+per param plus shared beta1_power/beta2_power "non-slot" scalars
+(adam.py:189-203), and the fused kernel's update rule (training_ops.h
+ApplyAdam):
+
+    lr_t   = lr * sqrt(1 - b2^t) / (1 - b1^t)
+    m_t    = b1*m + (1-b1)*g
+    v_t    = b2*v + (1-b2)*g^2
+    param -= lr_t * m_t / (sqrt(v_t) + eps)      # eps OUTSIDE the sqrt,
+                                                 # TF's convention
+
+Defaults match tf.train.AdamOptimizer: b1=0.9, b2=0.999, eps=1e-8. We keep a
+step counter instead of materialized beta-power variables (same numbers, one
+scalar instead of two). All state is f32 regardless of compute dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.optim.base import Optimizer
+
+
+def adam(
+    learning_rate: float | Callable[[jax.Array], jax.Array] = 0.01,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    *,
+    fused: bool = False,
+) -> Optimizer:
+    """`fused=True` routes the per-tensor slot+delta update through the
+    Pallas one-pass kernel (ops/pallas/fused_adam.py) instead of jnp ops;
+    same math, one HBM pass."""
+
+    def init(params):
+        zeros = lambda: jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
+        )
+        return {"m": zeros(), "v": zeros(), "count": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params):
+        del params
+        count = state["count"] + 1
+        t = count.astype(jnp.float32)
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        lr_t = lr * jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        if fused:
+            from dist_mnist_tpu.ops.pallas.fused_adam import fused_adam_update
+
+            flat_g, treedef = jax.tree.flatten(g32)
+            flat_m = treedef.flatten_up_to(state["m"])
+            flat_v = treedef.flatten_up_to(state["v"])
+            outs = [
+                fused_adam_update(g_, m_, v_, lr_t, b1=b1, b2=b2, eps=eps)
+                for g_, m_, v_ in zip(flat_g, flat_m, flat_v)
+            ]
+            updates = jax.tree.unflatten(treedef, [o[0] for o in outs])
+            m = jax.tree.unflatten(treedef, [o[1] for o in outs])
+            v = jax.tree.unflatten(treedef, [o[2] for o in outs])
+            return updates, {"m": m, "v": v, "count": count}
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], g32)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], g32)
+        updates = jax.tree.map(lambda m_, v_: -lr_t * m_ / (jnp.sqrt(v_) + eps), m, v)
+        return updates, {"m": m, "v": v, "count": count}
+
+    return Optimizer(init, update)
+
+
+def adamw(
+    learning_rate: float | Callable[[jax.Array], jax.Array] = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+) -> Optimizer:
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter): the decay term
+    bypasses the m/v normalization — update = adam_delta - lr*wd*param —
+    unlike chaining add_decayed_weights before adam (which is plain L2)."""
+    inner = adam(learning_rate, b1, b2, eps)
+
+    def update(grads, state, params):
+        count = state["count"] + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        updates, new_state = inner.update(grads, state, params)
+        updates = jax.tree.map(
+            lambda u, p: u - lr * weight_decay * p.astype(u.dtype),
+            updates, params,
+        )
+        return updates, new_state
+
+    return Optimizer(inner.init, update)

@@ -1,0 +1,90 @@
+"""Gradient-transformation core.
+
+An `Optimizer` is a pair of pure functions over pytrees:
+``init(params) -> state`` and
+``update(grads, state, params) -> (updates, new_state)``
+where `updates` are deltas (`params + updates` applies them). Composable via
+`chain`, mirroring how the reference composed SyncReplicasOptimizer around
+AdamOptimizer (sync_replicas_optimizer.py:215: "opt = SyncReplicas(opt)").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+Grads = Any
+State = Any
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Params], State]
+    update: Callable[[Grads, State, Params], tuple[Grads, State]]
+
+
+# kept as an alias for annotations in user code
+OptimizerDef = Optimizer
+
+
+def apply_updates(params: Params, updates: Grads) -> Params:
+    """params + updates, preserving param dtype (master weights stay f32)."""
+    return jax.tree.map(
+        lambda p, u: (p + u.astype(p.dtype)) if u is not None else p, params, updates
+    )
+
+
+def chain(*optimizers: Optimizer) -> Optimizer:
+    """Compose transformations left-to-right (grads flow through all)."""
+
+    def init(params):
+        return tuple(o.init(params) for o in optimizers)
+
+    def update(grads, state, params):
+        new_states = []
+        for o, s in zip(optimizers, state):
+            grads, ns = o.update(grads, s, params)
+            new_states.append(ns)
+        return grads, tuple(new_states)
+
+    return Optimizer(init, update)
+
+
+def scale(factor: float) -> Optimizer:
+    return Optimizer(
+        init=lambda params: (),
+        update=lambda g, s, p: (jax.tree.map(lambda x: x * factor, g), s),
+    )
+
+
+def global_norm(tree) -> jax.Array:
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree))
+    )
+
+
+def clip_by_global_norm(max_norm: float) -> Optimizer:
+    def update(grads, state, params):
+        norm = global_norm(grads)
+        factor = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+        return jax.tree.map(lambda g: g * factor, grads), state
+
+    return Optimizer(init=lambda p: (), update=update)
+
+
+def add_decayed_weights(weight_decay: float) -> Optimizer:
+    """L2 regularization: adds wd*p INTO the gradient, so when chained
+    before an adaptive optimizer the decay is scaled by its normalizer.
+    For decoupled (AdamW-style) decay use `optim.adamw` instead."""
+
+    def update(grads, state, params):
+        return (
+            jax.tree.map(lambda g, p: g + weight_decay * p.astype(g.dtype),
+                         grads, params),
+            state,
+        )
+
+    return Optimizer(init=lambda p: (), update=update)

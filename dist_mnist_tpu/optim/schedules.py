@@ -1,0 +1,32 @@
+"""Learning-rate schedules (callables of the int32 update count)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def constant(value: float):
+    return lambda count: jnp.float32(value)
+
+
+def cosine_decay(peak: float, total_steps: int, warmup_steps: int = 0,
+                 floor: float = 0.0):
+    def schedule(count):
+        t = count.astype(jnp.float32)
+        warm = peak * t / jnp.maximum(1.0, warmup_steps)
+        prog = jnp.clip(
+            (t - warmup_steps) / jnp.maximum(1.0, total_steps - warmup_steps), 0, 1
+        )
+        cos = floor + (peak - floor) * 0.5 * (1 + jnp.cos(jnp.pi * prog))
+        return jnp.where(t < warmup_steps, warm, cos)
+
+    return schedule
+
+
+def step_decay(base: float, boundaries: tuple[int, ...], factor: float = 0.1):
+    def schedule(count):
+        t = count.astype(jnp.float32)
+        n_passed = sum((t >= b).astype(jnp.float32) for b in boundaries)
+        return base * factor**n_passed
+
+    return schedule

@@ -1,0 +1,58 @@
+"""SGD and heavy-ball momentum (tf.train.GradientDescentOptimizer /
+MomentumOptimizer analogues — same family as training_ops.h
+ApplyGradientDescent / ApplyMomentum)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.optim.base import Optimizer
+
+
+def _lr_at(learning_rate, count):
+    return learning_rate(count) if callable(learning_rate) else learning_rate
+
+
+def sgd(learning_rate: float | Callable = 0.01) -> Optimizer:
+    def init(params):
+        return {"count": jnp.zeros((), jnp.int32)}
+
+    def update(grads, state, params):
+        count = state["count"] + 1
+        lr = _lr_at(learning_rate, count)
+        return (
+            jax.tree.map(lambda g: -lr * g.astype(jnp.float32), grads),
+            {"count": count},
+        )
+
+    return Optimizer(init, update)
+
+
+def momentum(
+    learning_rate: float | Callable = 0.01,
+    decay: float = 0.9,
+    nesterov: bool = False,
+) -> Optimizer:
+    def init(params):
+        return {
+            "velocity": jax.tree.map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), params
+            ),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def update(grads, state, params):
+        count = state["count"] + 1
+        lr = _lr_at(learning_rate, count)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        vel = jax.tree.map(lambda v, g: decay * v + g, state["velocity"], g32)
+        if nesterov:
+            updates = jax.tree.map(lambda v, g: -lr * (decay * v + g), vel, g32)
+        else:
+            updates = jax.tree.map(lambda v: -lr * v, vel)
+        return updates, {"velocity": vel, "count": count}
+
+    return Optimizer(init, update)

@@ -1,0 +1,78 @@
+"""Sync-replica semantics: gradient accumulation over microbatches.
+
+Reference protocol (SURVEY.md §2.3 row 9, §3.4): SyncReplicasOptimizer
+parks per-variable ConditionalAccumulators on the PS
+(sync_replicas_optimizer.py:274-293), each worker pushes a step-stamped
+gradient, the chief's queue-runner takes `replicas_to_aggregate` fresh
+gradients, averages, applies, and broadcasts tokens through a FIFOQueue
+barrier (:312-322). Backup replicas (`total_num_replicas >
+replicas_to_aggregate`) let the slowest K gradients be *dropped*.
+
+SPMD mapping (documented divergence, per SURVEY.md §7 hard part (a)):
+- The aggregate-then-apply barrier is exact: `psum` over the `data` axis is
+  a synchronous average of all replicas' gradients inside the step.
+- `replicas_to_aggregate = k * N` (aggregating MORE than one minibatch per
+  update) maps exactly to this module: accumulate k microbatch gradients,
+  apply on the k-th. Identical update math, k× the effective batch.
+- Dropping the slowest K gradients is NOT expressible in a lockstep SPMD
+  program (there is no "slowest" — all replicas finish the same compiled
+  step together), and with ICI all-reduce there is no straggler problem for
+  backup replicas to solve. We therefore do not emulate it; the async-PS
+  demo (parallel/ps_demo) shows the original protocol for reference.
+
+The accumulator's staleness guard (conditional_accumulator_base.h:34-37 —
+drop grads whose local_step < global_step) is unnecessary here: a step's
+gradients are, by construction, computed from the params of that same step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.optim.base import Optimizer
+
+
+def gradient_accumulation(inner: Optimizer, every: int) -> Optimizer:
+    """Apply `inner` once per `every` calls, averaging the buffered grads.
+
+    Between boundaries the returned updates are zeros (params unchanged),
+    matching the reference's worker view: non-aggregated steps leave
+    variables untouched until the chief's take_grad fires (§3.4).
+    Branchless (lax.cond-free): masks keep everything fusible and avoid
+    divergent control flow in the compiled step.
+    """
+    if every < 1:
+        raise ValueError("`every` must be >= 1")
+    if every == 1:
+        return inner
+
+    def init(params):
+        return {
+            "acc": jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+            "calls": jnp.zeros((), jnp.int32),
+            "inner": inner.init(params),
+        }
+
+    def update(grads, state, params):
+        calls = state["calls"] + 1
+        boundary = (calls % every) == 0
+        acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32) / every, state["acc"], grads
+        )
+        # Run the inner update unconditionally on the accumulated average,
+        # then mask: cheap relative to fwd/bwd and keeps one fused program.
+        inner_updates, inner_state = inner.update(acc, state["inner"], params)
+        updates = jax.tree.map(
+            lambda u: jnp.where(boundary, u, jnp.zeros_like(u)), inner_updates
+        )
+        new_inner = jax.tree.map(
+            lambda new, old: jnp.where(boundary, new, old), inner_state,
+            state["inner"],
+        )
+        new_acc = jax.tree.map(
+            lambda a: jnp.where(boundary, jnp.zeros_like(a), a), acc
+        )
+        return updates, {"acc": new_acc, "calls": calls, "inner": new_inner}
+
+    return Optimizer(init, update)

@@ -1,0 +1,33 @@
+"""Parallelism: sharding rules, explicit collectives, sequence parallelism.
+
+This package is the TPU-native replacement for the reference's entire
+distributed fabric (SURVEY.md §2.5 rows 21-27 — GrpcServer, Master/Worker
+services, graph partitioning, rendezvous, RecvTensor RPC): placement is a
+PartitionSpec per array instead of replica_device_setter's round-robin
+(§2.2 row 5), and every byte that crossed gRPC per step becomes an XLA
+collective over ICI compiled into the step program.
+
+- `sharding.py` — param/batch PartitionSpec rules per mesh axis (DP/TP).
+- `collectives.py` — thin named wrappers over lax collectives + shard_map
+  helpers for the explicit-SPMD path.
+- `ring_attention.py` — sequence-parallel ring attention (ppermute K/V).
+- `ulysses.py` — all-to-all head<->sequence reshard alternative.
+"""
+
+from dist_mnist_tpu.parallel.sharding import (
+    ShardingRules,
+    DP_RULES,
+    TP_RULES,
+    shard_train_state,
+    params_sharding,
+    tree_sharding,
+)
+
+__all__ = [
+    "ShardingRules",
+    "DP_RULES",
+    "TP_RULES",
+    "shard_train_state",
+    "params_sharding",
+    "tree_sharding",
+]

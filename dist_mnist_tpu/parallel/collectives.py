@@ -1,0 +1,107 @@
+"""Named collective helpers + the explicit-SPMD (shard_map) step variant.
+
+This is the manual-control counterpart of the GSPMD path in train/step.py:
+there XLA *infers* the all-reduce from shardings; here the collectives are
+written out. Each helper names the reference mechanism it replaces
+(SURVEY.md §3.3/§3.4) — together they are the entire user-visible surface
+of what was rows 21-27 of §2.5 (gRPC master/worker/rendezvous).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+
+
+def psum_mean(tree, axis_name: str = DATA_AXIS):
+    """Average a gradient pytree across an axis — the one collective that
+    replaces the whole PS push/pull + ConditionalAccumulator.take_grad
+    average (sync_replicas_optimizer.py:295-300): one ICI all-reduce,
+    in-program, overlapped by XLA with surrounding compute."""
+    n = lax.axis_size(axis_name)
+    return jax.tree.map(lambda g: lax.psum(g, axis_name) / n, tree)
+
+
+def ring_shift(x, axis_name: str, *, reverse: bool = False):
+    """Rotate x one step around the axis ring via ppermute (the building
+    block of ring attention / ring all-reduce; rides neighbour ICI links)."""
+    n = lax.axis_size(axis_name)
+    if reverse:
+        perm = [(i, (i - 1) % n) for i in range(n)]
+    else:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all_heads(x, axis_name: str, *, split_axis: int, concat_axis: int):
+    """Tiled all_to_all (Ulysses reshard: scatter one axis, gather another)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def make_explicit_dp_step(model, optimizer, mesh: Mesh, *, loss_fn=None):
+    """Data-parallel train step with hand-written collectives via shard_map.
+
+    Semantically identical to train/step.make_train_step on a pure-DP mesh;
+    exists (a) as executable documentation of where the all-reduce sits in
+    the step, (b) as the template for hybrid strategies where manual
+    placement beats GSPMD inference. Per-device closure: grads are psum-
+    averaged BEFORE the optimizer update, so optimizer state stays bitwise
+    identical across replicas — the invariant the PS enforced by having one
+    copy of the slots (SURVEY.md §2.3 row 7).
+    """
+    from dist_mnist_tpu.ops import losses as losses_lib, metrics
+    from dist_mnist_tpu.optim.base import apply_updates
+    from dist_mnist_tpu.train.state import TrainState
+
+    loss_fn = loss_fn or losses_lib.softmax_cross_entropy
+
+    def per_device_step(state: TrainState, batch):
+        # state replicated; batch holds this device's shard of the batch
+        step_key = jax.random.fold_in(state.rng, state.step)
+        x = batch["image"].astype(jnp.float32) / 255.0
+        y = batch["label"]
+
+        def loss_of(params):
+            logits, new_ms = model.apply(
+                params, state.model_state, x, train=True, rng=step_key
+            )
+            return loss_fn(logits, y), (logits, new_ms)
+
+        (loss, (logits, new_ms)), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(state.params)
+        # THE collective: replaces RecvTensor push/pull (§3.3)
+        grads = psum_mean(grads, DATA_AXIS)
+        # BN running stats were computed on local shards; average them so the
+        # replicated-state invariant holds (GSPMD's sync-BN equivalent)
+        new_ms = jax.tree.map(lambda a: lax.pmean(a, DATA_AXIS), new_ms)
+        loss = lax.pmean(loss, DATA_AXIS)
+        acc = lax.pmean(metrics.accuracy(logits, y), DATA_AXIS)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=apply_updates(state.params, updates),
+            model_state=new_ms,
+            opt_state=new_opt,
+            rng=state.rng,
+        )
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    state_spec = P()  # replicated
+    batch_spec = {"image": P(DATA_AXIS), "label": P(DATA_AXIS)}
+
+    sharded = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

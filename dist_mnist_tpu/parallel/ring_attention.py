@@ -1,0 +1,102 @@
+"""Ring attention — sequence/context parallelism over the `seq` mesh axis.
+
+Absent from the reference (fixed 784-pixel inputs — SURVEY.md §5.7), but a
+first-class capability here: long sequences are sharded over `seq`; each
+device holds its local Q/K/V slice, K/V blocks rotate around the ICI ring
+via `ppermute`, and softmax is accumulated blockwise in log-sum-exp form
+(the numerically exact streaming softmax), so no device ever materializes
+the full S x S score matrix — attention memory is O(S_local^2 * ring) time,
+O(S_local) memory per device.
+
+Two entry points:
+- `ring_attention_inner(q, k, v, axis_name)` — call INSIDE shard_map.
+- `ring_self_attention(q, k, v, mesh)` — wraps shard_map over `mesh`'s
+  `seq` axis (composes under jit).
+- `ring_attention(q, k, v)` — convenience used by models: rings over the
+  ambient mesh when it has a seq axis > 1, else falls back to plain
+  attention (so the same model code runs on any mesh).
+
+Non-causal (bidirectional) attention, matching ops/nn.dot_product_attention;
+inputs [B, S(, _local), H, D].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P, get_abstract_mesh
+
+from dist_mnist_tpu.cluster.mesh import SEQ_AXIS
+from dist_mnist_tpu.parallel.collectives import ring_shift
+
+
+def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
+    """Blockwise-LSE ring attention; q/k/v are this device's [B,Sl,H,D]."""
+    n = lax.axis_size(axis_name)
+    scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32)
+
+    def block(qf, k_blk, v_blk):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        logits *= scale
+        m = jnp.max(logits, axis=-1)  # [B,H,Sq]
+        p = jnp.exp(logits - m[..., None])
+        num = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        den = jnp.sum(p, axis=-1)  # [B,H,Sq]
+        return num, den, m
+
+    def body(i, carry):
+        acc_num, acc_den, acc_max, k_blk, v_blk = carry
+        num, den, m = block(qf, k_blk, v_blk)
+        new_max = jnp.maximum(acc_max, m)
+        old_scale = jnp.exp(acc_max - new_max)
+        blk_scale = jnp.exp(m - new_max)
+        sc = lambda s: jnp.moveaxis(s, -1, 1)[..., None]  # [B,H,Sq]->[B,Sq,H,1]
+        acc_num = acc_num * sc(old_scale) + num * sc(blk_scale)
+        acc_den = acc_den * old_scale + den * blk_scale
+        # rotate K/V to the next ring position (neighbour ICI hop); XLA
+        # overlaps the ppermute with the current block's compute
+        k_blk = ring_shift(k_blk, axis_name)
+        v_blk = ring_shift(v_blk, axis_name)
+        return acc_num, acc_den, new_max, k_blk, v_blk
+
+    b, sl, h, d = q.shape
+    init = (
+        jnp.zeros((b, sl, h, d), jnp.float32),
+        jnp.zeros((b, h, sl), jnp.float32),
+        jnp.full((b, h, sl), -jnp.inf, jnp.float32),
+        k,
+        v,
+    )
+    acc_num, acc_den, _, _, _ = lax.fori_loop(0, n, body, init)
+    out = acc_num / jnp.moveaxis(acc_den, -1, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
+    """shard_map wrapper: shards the sequence dim (1) of [B,S,H,D] over
+    `axis_name` and runs the ring. Batch/heads stay as-is (combine with
+    `data`/`model` sharding freely — the specs only constrain dim 1)."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(ring_attention_inner, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ring_attention(q, k, v):
+    """Mesh-adaptive entry used by models: ring over the ambient mesh's
+    `seq` axis when present (>1), else exact fallback."""
+    mesh = get_abstract_mesh()
+    if mesh is None or SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] == 1:
+        from dist_mnist_tpu.ops.nn import dot_product_attention
+
+        return dot_product_attention(q, k, v)
+    return ring_self_attention(q, k, v, mesh)

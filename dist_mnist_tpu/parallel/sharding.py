@@ -1,0 +1,84 @@
+"""Placement rules: path-pattern -> PartitionSpec.
+
+Replaces `replica_device_setter` (SURVEY.md §2.2 row 5): the reference
+decided placement by *op type* (Variable-ish ops round-robin onto ps tasks,
+device_setter.py:92-125); we decide by *param path* against mesh axes. Data
+parallelism = params replicated, batch sharded on `data`; tensor parallelism
+= matmul weights sharded on `model` (Megatron-style column/row pairs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Ordered (regex, spec-maker) rules; first match wins, default
+    replicated. The spec maker receives the array's ndim so a rule can place
+    an axis relative to the end (e.g. "last dim over model")."""
+
+    rules: tuple[tuple[str, tuple], ...] = ()
+
+    def spec_for(self, path: str, ndim: int) -> P:
+        for pattern, axes in self.rules:
+            if re.search(pattern, path):
+                if len(axes) > ndim:  # rule doesn't fit (e.g. bias) -> last dims
+                    axes = axes[-ndim:] if ndim else ()
+                pad = (None,) * (ndim - len(axes))
+                return P(*(pad + tuple(axes)))
+        return P()  # replicated
+
+
+# Pure data parallelism: every param replicated.
+DP_RULES = ShardingRules()
+
+# Megatron-style TP for the transformer blocks + big fc layers:
+#  - qkv / mlp_in: column-parallel (output dim over `model`)
+#  - out / mlp_out: row-parallel  (input dim over `model`)
+# Biases of row-parallel layers stay replicated (added after the reduce).
+TP_RULES = ShardingRules(
+    rules=(
+        (r"(qkv|mlp_in|fc1)/w$", (None, MODEL_AXIS)),
+        (r"(qkv|mlp_in|fc1)/b$", (MODEL_AXIS,)),
+        (r"(attn/out|mlp_out|fc2)/w$", (MODEL_AXIS, None)),
+    )
+)
+
+
+def _paths(tree):
+    flat, treedef = jax.tree.flatten_with_path(tree)
+    paths = ["/".join(str(getattr(k, "key", k)) for k in path) for path, _ in flat]
+    return flat, treedef, paths
+
+
+def tree_sharding(tree, mesh: Mesh, rules: ShardingRules):
+    """Matching pytree of NamedShardings for `tree` under `rules`."""
+    flat, treedef, paths = _paths(tree)
+    shardings = [
+        NamedSharding(mesh, rules.spec_for(p, getattr(v, "ndim", 0)))
+        for p, (_, v) in zip(paths, flat)
+    ]
+    return jax.tree.unflatten(treedef, shardings)
+
+
+def params_sharding(params, mesh: Mesh, rules: ShardingRules = DP_RULES):
+    return tree_sharding(params, mesh, rules)
+
+
+def shard_train_state(state, mesh: Mesh, rules: ShardingRules = DP_RULES):
+    """Device_put a TrainState with params/opt-state placed by `rules`.
+
+    Optimizer slots (Adam m/v — the reference's PS-resident slot variables,
+    adam.py:189-203) inherit their param's spec: slot math is elementwise,
+    so colocating slot shards with param shards makes the update fully
+    local, exactly as slot-colocated-with-variable did on the PS.
+    """
+    sharded = tree_sharding(state, mesh, rules)
+    return jax.device_put(state, sharded)

@@ -1,0 +1,47 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->sequence reshard.
+
+The alternative to ring attention (SURVEY.md §5.7 "Ulysses"): instead of
+rotating K/V blocks, one `all_to_all` over ICI converts the sequence-sharded
+layout [B, S/n, H, D] into a head-sharded layout [B, S, H/n, D]; attention
+then runs fully local per device (exact, no streaming softmax needed), and a
+second all_to_all restores the sequence sharding. Cheaper than ring when
+H >= ring size and S_local is small; ring wins for very long S (its
+memory stays O(S_local)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import SEQ_AXIS
+from dist_mnist_tpu.ops.nn import dot_product_attention
+
+
+def ulysses_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
+    """Inside shard_map: [B, S_local, H, D] per device; H % axis_size == 0."""
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(f"heads {q.shape[2]} not divisible by seq axis {n}")
+    # scatter heads (axis 2), gather sequence (axis 1): -> [B, S, H/n, D]
+    reshard = lambda x: lax.all_to_all(x, axis_name, split_axis=2,
+                                       concat_axis=1, tiled=True)
+    unshard = lambda x: lax.all_to_all(x, axis_name, split_axis=1,
+                                       concat_axis=2, tiled=True)
+    out = dot_product_attention(reshard(q), reshard(k), reshard(v))
+    return unshard(out)
+
+
+def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(ulysses_attention_inner, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

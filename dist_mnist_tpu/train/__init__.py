@@ -1,0 +1,22 @@
+"""Training core: state pytree, compiled SPMD step, hooked loop.
+
+Replaces the reference's session/lifecycle layer (SURVEY.md §2.4): the
+entire §3.3 per-step stack (client session -> Master RunStep -> partitioned
+executors -> rendezvous RecvTensor) becomes ONE jit-compiled XLA program
+(`step.py`), and MonitoredTrainingSession's wrapper/hook machinery becomes
+`TrainLoop` (`loop.py`) + the hook protocol (`hooks/`).
+"""
+
+from dist_mnist_tpu.train.state import TrainState, create_train_state
+from dist_mnist_tpu.train.step import make_train_step, make_eval_step, evaluate
+from dist_mnist_tpu.train.loop import TrainLoop, StopSignal
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+    "evaluate",
+    "TrainLoop",
+    "StopSignal",
+]

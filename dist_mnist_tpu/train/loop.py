@@ -1,0 +1,147 @@
+"""The hooked train loop — MonitoredTrainingSession, functional.
+
+Maps the reference session-wrapper stack (SURVEY.md §2.4 rows 13-16, §3.2/3.3)
+onto plain control flow:
+
+- `_HookedSession`'s before/after_run merge (:1414-1508) -> hook calls
+  around the compiled step.
+- `_CoordinatedSession` + Coordinator (:1347-1411; coordinator.py) ->
+  `StopSignal` (request_stop / should_stop / stored exception).
+- `_RecoverableSession`'s preemption ring (:1238-1344, retrying only
+  `_PREEMPTION_ERRORS` = Aborted/Unavailable, :43-45) -> `max_recoveries` +
+  restore-from-checkpoint on a matching error class. In SPMD there is no
+  session to rebuild; recovery = reload last checkpoint and continue, which
+  is exactly what SessionManager.recover_session did for the chief (§3.2).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Sequence
+
+from dist_mnist_tpu.hooks.base import Hook
+from dist_mnist_tpu.train.state import TrainState
+
+log = logging.getLogger(__name__)
+
+
+class PreemptionError(RuntimeError):
+    """Raise-able stand-in for a preempted device/host (tests inject it, the
+    way upstream injected AbortedError into _RecoverableSession — §4)."""
+
+
+#: Exceptions treated as recoverable, mirroring _PREEMPTION_ERRORS
+#: (monitored_session.py:43-45). jax surfaces device loss as XlaRuntimeError
+#: (a subclass of JaxRuntimeError); we match by name to stay version-proof.
+def _is_preemption(exc: BaseException) -> bool:
+    if isinstance(exc, PreemptionError):
+        return True
+    return type(exc).__name__ in ("XlaRuntimeError", "JaxRuntimeError") and any(
+        s in str(exc) for s in ("UNAVAILABLE", "ABORTED", "preempt")
+    )
+
+
+class StopSignal:
+    """Coordinator analogue (coordinator.py:28-400), minus the threads: the
+    loop is single-threaded per process, but hooks and outer code still need
+    a cooperative stop + exception channel."""
+
+    def __init__(self):
+        self._stop = False
+        self.reason: str | None = None
+        self.exception: BaseException | None = None
+
+    def request_stop(self, reason: str | None = None,
+                     exc: BaseException | None = None) -> None:
+        if not self._stop:
+            self._stop = True
+            self.reason = reason
+            self.exception = exc
+
+    def should_stop(self) -> bool:
+        return self._stop
+
+    def raise_requested_exception(self) -> None:
+        if self.exception is not None:
+            raise self.exception
+
+
+class TrainLoop:
+    """Run `state = step_fn(state, batch)` over `batches` with hooks.
+
+    `checkpoint_manager` (checkpoint/manager.py) enables preemption
+    recovery: on a recoverable error the loop restores the latest
+    checkpoint and continues, up to `max_recoveries` times.
+    """
+
+    def __init__(
+        self,
+        step_fn,
+        state: TrainState,
+        batches: Iterable,
+        hooks: Sequence[Hook] = (),
+        *,
+        checkpoint_manager=None,
+        max_recoveries: int = 0,
+    ):
+        self.step_fn = step_fn
+        self.state = state
+        self.batches = batches
+        self.hooks = list(hooks)
+        self.stop = StopSignal()
+        self.checkpoint_manager = checkpoint_manager
+        self.max_recoveries = max_recoveries
+        self.initial_step = state.step_int
+        self._host_step = self.initial_step  # host mirror of state.step:
+        # tracks the global step without a device sync per step
+
+    def request_stop(self, reason: str | None = None) -> None:
+        self.stop.request_stop(reason)
+
+    def run(self) -> TrainState:
+        for h in self.hooks:
+            h.begin(self)
+        recoveries = 0
+        it = iter(self.batches)
+        try:
+            while not self.stop.should_stop():
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    self.request_stop("data exhausted")
+                    break
+                try:
+                    # step number BEFORE the step executes == the step being
+                    # run; hooks see the post-step number like global_step
+                    # reads did after the AssignAdd (§3.3).
+                    for h in self.hooks:
+                        h.before_step(self._host_step)
+                    new_state, outputs = self.step_fn(self.state, batch)
+                    self.state = new_state
+                    self._host_step += 1
+                    for h in self.hooks:
+                        h.after_step(self._host_step, self.state, outputs)
+                except Exception as exc:  # noqa: BLE001 — classified below
+                    if not (
+                        _is_preemption(exc)
+                        and self.checkpoint_manager is not None
+                        and recoveries < self.max_recoveries
+                    ):
+                        raise
+                    recoveries += 1
+                    log.warning(
+                        "recoverable failure (%s); restore attempt %d/%d",
+                        exc, recoveries, self.max_recoveries,
+                    )
+                    restored = self.checkpoint_manager.restore(self.state)
+                    if restored is None:
+                        raise
+                    self.state = restored
+                    self._host_step = self.state.step_int
+        finally:
+            for h in self.hooks:
+                try:
+                    h.end(self.state)
+                except Exception:  # noqa: BLE001 — end() must not mask body
+                    log.exception("hook %s.end failed", type(h).__name__)
+        return self.state

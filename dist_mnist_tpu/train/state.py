@@ -1,0 +1,46 @@
+"""TrainState — the single pytree that *is* the training job's state.
+
+Subsumes what the reference scattered across processes: PS-resident
+variables + optimizer slots (SURVEY.md §2.3 rows 6-8), the global_step
+variable (§2.4 row 20, training_util.py:165-255), and per-worker RNG.
+Checkpointing this one pytree (checkpoint/manager.py) replaces Saver's
+graph-embedded SaveV2/RestoreV2 of the same set (§2.4 row 19).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array  # int32 scalar — the global_step (§2.4 row 20)
+    params: Any  # f32 master weights
+    model_state: Any  # BN running stats etc.; {} for stateless models
+    opt_state: Any  # optimizer slots (Adam m/v + count)
+    rng: jax.Array  # base PRNG key; per-step keys are fold_in(rng, step)
+
+    @property
+    def step_int(self) -> int:
+        return int(jax.device_get(self.step))
+
+
+def create_train_state(model, optimizer, rng: jax.Array, sample_input) -> TrainState:
+    """Build the initial state. Unlike the reference — where ONLY the chief
+    ran init_op and workers blocked in wait_for_session (§3.2,
+    session_manager.py:259,419) — every process derives identical initial
+    params from the same seed; there is nothing to wait for."""
+    init_key, loop_key = jax.random.split(rng)
+    params, model_state = model.init(init_key, sample_input)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        model_state=model_state,
+        opt_state=optimizer.init(params),
+        rng=loop_key,
+    )

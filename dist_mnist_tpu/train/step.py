@@ -1,0 +1,159 @@
+"""The compiled SPMD training step.
+
+This single function replaces the reference's entire per-step distributed
+machinery (SURVEY.md §3.3): forward, backward, gradient all-reduce,
+optimizer update, and global_step increment are ONE XLA program. The
+weight-pull/grad-push that crossed gRPC every step (RecvTensor, worker.h:85)
+is the all-reduce XLA inserts over ICI when the batch is sharded on the
+`data` mesh axis and params are replicated (GSPMD); with TP rules the same
+mechanism inserts the Megatron reduce in the matmuls. No hand-written
+collectives needed on this path — parallel/collectives.py has the explicit
+shard_map variant for cases that want manual control.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dist_mnist_tpu.data.pipeline import batch_sharding
+from dist_mnist_tpu.ops import losses, metrics
+from dist_mnist_tpu.optim.base import Optimizer, apply_updates, global_norm
+from dist_mnist_tpu.parallel.sharding import ShardingRules, DP_RULES, tree_sharding
+from dist_mnist_tpu.train.state import TrainState
+
+LossFn = Callable[..., jax.Array]
+
+
+def make_train_step(
+    model,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    *,
+    loss_fn: LossFn = losses.softmax_cross_entropy,
+    rules: ShardingRules = DP_RULES,
+    donate: bool = True,
+    with_grad_norm: bool = False,
+):
+    """Build `step(state, batch) -> (state, metrics)` jitted over `mesh`.
+
+    - `donate=True` aliases the input state's buffers into the output
+      (in-place param update in HBM — the analogue of the reference's
+      mutable PS variables, without the mutation).
+    - batch["image"] is uint8 NHWC sharded on `data`; normalization to
+      [0,1] f32 runs on-device post-shard (4x less host->device traffic).
+    """
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        step_key = jax.random.fold_in(state.rng, state.step)
+        x = batch["image"].astype(jnp.float32) / 255.0
+        y = batch["label"]
+
+        def loss_of(params):
+            logits, new_model_state = model.apply(
+                params, state.model_state, x, train=True, rng=step_key
+            )
+            loss = loss_fn(logits, y)
+            return loss, (logits, new_model_state)
+
+        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+            loss_of, has_aux=True
+        )(state.params)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=apply_updates(state.params, updates),
+            model_state=new_model_state,
+            opt_state=new_opt_state,
+            rng=state.rng,
+        )
+        out = {
+            "loss": loss.astype(jnp.float32),
+            "accuracy": metrics.accuracy(logits, y),
+        }
+        if with_grad_norm:
+            out["grad_norm"] = global_norm(grads)
+        return new_state, out
+
+    state_shardings = lambda state: tree_sharding(state, mesh, rules)
+    batch_shd = {"image": batch_sharding(mesh), "label": batch_sharding(mesh)}
+
+    def jitted(state_example: TrainState):
+        """Compile with shardings derived from a concrete/abstract state."""
+        return jax.jit(
+            step,
+            in_shardings=(state_shardings(state_example), batch_shd),
+            out_shardings=(state_shardings(state_example), None),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    # Most callers just want the step; compile lazily on first call with the
+    # actual state so sharding pytrees always match.
+    compiled_cache: dict = {}
+
+    def step_fn(state: TrainState, batch):
+        if "fn" not in compiled_cache:
+            compiled_cache["fn"] = jitted(state)
+        return compiled_cache["fn"](state, batch)
+
+    step_fn.lower = lambda state, batch: jitted(state).lower(state, batch)
+    return step_fn
+
+
+def make_eval_step(model, mesh: Mesh):
+    """`eval_step(state, batch) -> (sum_loss, correct_count, n)` — summable
+    partial results so full-test-set eval streams in fixed-size batches."""
+
+    @jax.jit
+    def eval_step(state: TrainState, batch):
+        x = batch["image"].astype(jnp.float32) / 255.0
+        y = batch["label"]
+        logits, _ = model.apply(state.params, state.model_state, x, train=False)
+        # Padding rows carry label -1: one_hot(-1) is the zero row, so their
+        # loss contribution is exactly 0, and argmax (>=0) never equals -1,
+        # so they count 0 correct. n counts only real rows.
+        loss_sum = losses.softmax_cross_entropy(logits, y, reduction="sum")
+        correct = metrics.correct_count(logits, y)
+        n = jnp.sum((y >= 0).astype(jnp.int32))
+        return loss_sum, correct, n
+
+    return eval_step
+
+
+def evaluate(eval_step, state, images, labels, mesh: Mesh, batch_size: int = 1000):
+    """Full-dataset eval: pads to a batch multiple, masks the padding."""
+    import numpy as np
+
+    from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+    from dist_mnist_tpu.data.pipeline import shard_batch
+
+    data_axis = mesh.shape[DATA_AXIS]
+    n_proc, pid = jax.process_count(), jax.process_index()
+    quantum = np.lcm(data_axis, n_proc)
+    batch_size = ((batch_size + quantum - 1) // quantum) * quantum
+    local_bs = batch_size // n_proc
+    n = images.shape[0]
+    total_loss, total_correct, total_n = 0.0, 0, 0
+    for i in range(0, n, batch_size):
+        img = images[i : i + batch_size]
+        lab = labels[i : i + batch_size]
+        if img.shape[0] < batch_size:  # pad tail; label -1 marks padding
+            pad = batch_size - img.shape[0]
+            img = np.concatenate([img, np.zeros((pad, *img.shape[1:]), img.dtype)])
+            lab = np.concatenate([lab, np.full((pad,), -1, lab.dtype)])
+        # shard_batch expects each process's LOCAL slice of the global batch
+        img = img[pid * local_bs : (pid + 1) * local_bs]
+        lab = lab[pid * local_bs : (pid + 1) * local_bs]
+        batch = shard_batch({"image": img, "label": lab}, mesh)
+        loss_sum, correct, n_real = eval_step(state, batch)
+        total_correct += int(correct)
+        total_n += int(n_real)
+        total_loss += float(loss_sum)
+    return {
+        "loss": total_loss / total_n,
+        "accuracy": total_correct / total_n,
+        "n": total_n,
+    }

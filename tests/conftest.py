@@ -1,0 +1,61 @@
+"""Test fixtures.
+
+The "cluster in one process" strategy (SURVEY.md §4): the reference tested
+multi-task behavior with in-process gRPC servers (create_local_cluster,
+test_util.py:4029); we fake an 8-device mesh on CPU with
+--xla_force_host_platform_device_count so every pjit/collective path runs in
+CI without a TPU. The axon sitecustomize in this image force-selects the TPU
+platform, so the override must happen in-process before backend init.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _cpu_devices():
+    assert jax.device_count() == 8, "tests expect the forced 8-device CPU mesh"
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """Pure-DP mesh over all 8 fake devices."""
+    return make_mesh(MeshSpec(data=8))
+
+
+@pytest.fixture(scope="session")
+def mesh_tp():
+    """Hybrid mesh: 4-way data x 2-way model."""
+    return make_mesh(MeshSpec(data=4, model=2))
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    """Single-device mesh (data=1) for reference results."""
+    return make_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(scope="session")
+def small_mnist():
+    """Small synthetic MNIST so tests stay fast."""
+    from dist_mnist_tpu.data.datasets import load_dataset
+
+    return load_dataset("mnist", "/nonexistent", seed=0,
+                        synthetic_sizes=(4096, 512))

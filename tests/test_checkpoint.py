@@ -1,0 +1,107 @@
+"""Orbax checkpoint manager: round-trip, retention, restore-or-init,
+kill/resume (SURVEY.md §3.5 / §5.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_mnist_tpu import optim
+from dist_mnist_tpu.checkpoint import CheckpointManager
+from dist_mnist_tpu.models import get_model
+from dist_mnist_tpu.parallel.sharding import shard_train_state
+from dist_mnist_tpu.train import create_train_state
+
+
+@pytest.fixture()
+def state(mesh8):
+    model = get_model("mlp", hidden_units=16)
+    opt = optim.adam(0.01)
+    with mesh8:
+        s = create_train_state(
+            model, opt, jax.random.PRNGKey(0), np.zeros((1, 28, 28, 1), np.uint8)
+        )
+        return shard_train_state(s, mesh8)
+
+
+def test_save_restore_roundtrip(tmp_path, state):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    assert mgr.latest_step() is None
+    assert mgr.save(state)
+    mgr.wait()
+    assert mgr.latest_step() == 0
+    import dataclasses
+
+    zeroed = dataclasses.replace(
+        state,
+        params=jax.tree.map(jnp.zeros_like, state.params),
+        step=jnp.int32(99),
+    )
+    restored = mgr.restore(zeroed)
+    assert restored is not None
+    assert restored.step_int == 0
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["hid"]["w"]),
+        np.asarray(state.params["hid"]["w"]),
+    )
+    # shardings survive restore (collective restore on multi-host)
+    assert restored.params["hid"]["w"].sharding == state.params["hid"]["w"].sharding
+    mgr.close()
+
+
+def test_restore_or_init(tmp_path, state):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    out, restored = mgr.restore_or_init(state)
+    assert not restored and out is state
+    mgr.save(state)
+    mgr.wait()
+    out, restored = mgr.restore_or_init(state)
+    assert restored
+    mgr.close()
+
+
+def test_dedupe_same_step(tmp_path, state):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    assert mgr.save(state)
+    mgr.wait()
+    assert not mgr.save(state)  # same step: deduped
+    mgr.close()
+
+
+def test_kill_resume_cycle(tmp_path, mesh8, state):
+    """Simulated preemption: save at step N in one manager, 'restart' with a
+    fresh manager + fresh init, resume from N (the SessionManager
+    prepare_session flow, §3.2)."""
+    import dataclasses
+
+    mgr1 = CheckpointManager(tmp_path, async_save=False)
+    advanced = dataclasses.replace(state, step=jnp.int32(123))
+    mgr1.save(advanced)
+    mgr1.wait()
+    mgr1.close()
+    # "process restart": new manager, newly-initialized state
+    mgr2 = CheckpointManager(tmp_path, async_save=False)
+    fresh = dataclasses.replace(
+        state, params=jax.tree.map(jnp.zeros_like, state.params)
+    )
+    resumed, was_restored = mgr2.restore_or_init(fresh)
+    assert was_restored
+    assert resumed.step_int == 123
+    np.testing.assert_array_equal(
+        np.asarray(resumed.params["hid"]["w"]),
+        np.asarray(state.params["hid"]["w"]),
+    )
+    mgr2.close()
+
+
+def test_max_to_keep(tmp_path, state):
+    import dataclasses
+
+    mgr = CheckpointManager(tmp_path, max_to_keep=2, async_save=False)
+    for s in (1, 2, 3, 4):
+        mgr.save(dataclasses.replace(state, step=jnp.int32(s)))
+        mgr.wait()
+    steps = mgr._mgr.all_steps()
+    assert mgr.latest_step() == 4
+    assert len(steps) <= 2
+    mgr.close()

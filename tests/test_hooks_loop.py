@@ -1,0 +1,204 @@
+"""Hook lifecycle + TrainLoop semantics (MonitoredTrainingSession parity)."""
+
+import itertools
+
+import jax.numpy as jnp
+import pytest
+
+from dist_mnist_tpu.hooks import (
+    EvalHook,
+    LoggingHook,
+    NaNGuardHook,
+    NanLossError,
+    StepCounterHook,
+    StopAtStepHook,
+)
+from dist_mnist_tpu.hooks.base import EverySteps, Hook
+from dist_mnist_tpu.train.loop import PreemptionError, StopSignal, TrainLoop
+from dist_mnist_tpu.train.state import TrainState
+
+
+def _state(step=0):
+    return TrainState(
+        step=jnp.int32(step), params={}, model_state={}, opt_state={},
+        rng=jnp.zeros((2,), jnp.uint32),
+    )
+
+
+def _fake_step(state, batch):
+    return (
+        TrainState(
+            step=state.step + 1, params=state.params,
+            model_state=state.model_state, opt_state=state.opt_state,
+            rng=state.rng,
+        ),
+        {"loss": jnp.float32(batch)},
+    )
+
+
+def test_stop_at_step():
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                     [StopAtStepHook(last_step=7)])
+    final = loop.run()
+    assert final.step_int == 7
+    assert loop.stop.reason == "reached last step"
+
+
+def test_stop_num_steps_from_restore():
+    """num_steps counts from the restored step (≙ StopAtStepHook:441-447)."""
+    loop = TrainLoop(_fake_step, _state(step=10), itertools.repeat(1.0),
+                     [StopAtStepHook(num_steps=5)])
+    assert loop.run().step_int == 15
+
+
+def test_data_exhaustion_stops():
+    loop = TrainLoop(_fake_step, _state(), iter([1.0, 1.0, 1.0]), [])
+    assert loop.run().step_int == 3
+    assert loop.stop.reason == "data exhausted"
+
+
+def test_hook_order_and_lifecycle():
+    calls = []
+
+    class Recorder(Hook):
+        def begin(self, loop):
+            calls.append("begin")
+
+        def before_step(self, step):
+            calls.append(f"before{step}")
+
+        def after_step(self, step, state, outputs):
+            calls.append(f"after{step}")
+
+        def end(self, state):
+            calls.append("end")
+
+    loop = TrainLoop(_fake_step, _state(), iter([1.0, 2.0]), [Recorder()])
+    loop.run()
+    assert calls == ["begin", "before0", "after1", "before1", "after2", "end"]
+
+
+def test_nan_guard_raises():
+    hook = NaNGuardHook(every_steps=1)
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(float("nan")),
+                     [hook, StopAtStepHook(last_step=10)])
+    with pytest.raises(NanLossError):
+        loop.run()
+
+
+def test_nan_guard_stop_mode():
+    hook = NaNGuardHook(every_steps=1, fail_on_nan=False)
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(float("nan")),
+                     [hook, StopAtStepHook(last_step=10)])
+    final = loop.run()
+    assert final.step_int == 1
+    assert loop.stop.reason == "non-finite loss"
+
+
+def test_step_counter_rate():
+    hook = StepCounterHook(every_steps=5, batch_size=32)
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                     [hook, StopAtStepHook(last_step=10)])
+    loop.run()
+    assert hook.last_rate is not None and hook.last_rate > 0
+
+
+def test_eval_hook_cadence_and_end():
+    evals = []
+    hook = EvalHook(lambda s: evals.append(s.step_int) or
+                    {"loss": 0.0, "accuracy": 1.0}, every_steps=4)
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                     [hook, StopAtStepHook(last_step=10)])
+    loop.run()
+    assert evals == [4, 8, 10]  # cadence + final
+
+
+def test_every_steps_requires_config():
+    with pytest.raises(ValueError):
+        EverySteps()
+
+
+def test_stop_signal_exception_channel():
+    sig = StopSignal()
+    exc = RuntimeError("boom")
+    sig.request_stop("bad", exc)
+    assert sig.should_stop()
+    with pytest.raises(RuntimeError, match="boom"):
+        sig.raise_requested_exception()
+
+
+class _FlakyStep:
+    """Fails with a preemption error on chosen calls (§4 injection pattern)."""
+
+    def __init__(self, fail_at: set[int]):
+        self.calls = 0
+        self.fail_at = fail_at
+
+    def __call__(self, state, batch):
+        self.calls += 1
+        if self.calls in self.fail_at:
+            raise PreemptionError("fake preemption")
+        return _fake_step(state, batch)
+
+
+class _MemoryCkpt:
+    """In-memory checkpoint manager double."""
+
+    def __init__(self):
+        self.saved = None
+
+    def save(self, state):
+        self.saved = state
+
+    def restore(self, target):
+        return self.saved
+
+
+def test_recoverable_loop_restores_and_continues():
+    mgr = _MemoryCkpt()
+    step = _FlakyStep(fail_at={4})
+    state = _state()
+    mgr.save(state)  # initial checkpoint at step 0
+
+    loop = TrainLoop(step, state, itertools.repeat(1.0),
+                     [StopAtStepHook(last_step=6)],
+                     checkpoint_manager=mgr, max_recoveries=2)
+    final = loop.run()
+    assert final.step_int == 6  # recovered from step 0 and finished
+
+
+def test_unrecoverable_without_manager():
+    step = _FlakyStep(fail_at={2})
+    loop = TrainLoop(step, _state(), itertools.repeat(1.0),
+                     [StopAtStepHook(last_step=6)])
+    with pytest.raises(PreemptionError):
+        loop.run()
+
+
+def test_non_preemption_errors_propagate():
+    def bad_step(state, batch):
+        raise ValueError("logic bug")
+
+    loop = TrainLoop(bad_step, _state(), itertools.repeat(1.0),
+                     [StopAtStepHook(last_step=6)],
+                     checkpoint_manager=_MemoryCkpt(), max_recoveries=5)
+    with pytest.raises(ValueError, match="logic bug"):
+        loop.run()
+
+
+def test_stop_hook_no_extra_step_after_restore():
+    """Restored at/past last_step: exit immediately, don't train one more."""
+    loop = TrainLoop(_fake_step, _state(step=2000), itertools.repeat(1.0),
+                     [StopAtStepHook(last_step=2000)])
+    assert loop.run().step_int == 2000
+    assert loop.stop.reason == "already at last step"
+
+
+def test_eval_hook_no_double_eval_when_final_on_cadence():
+    evals = []
+    hook = EvalHook(lambda s: evals.append(s.step_int) or
+                    {"loss": 0.0, "accuracy": 1.0}, every_steps=4)
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                     [hook, StopAtStepHook(last_step=8)])
+    loop.run()
+    assert evals == [4, 8]  # end() skipped: step 8 already evaluated

@@ -1,0 +1,62 @@
+"""IDX codec: round-trip (property-based, per SURVEY.md §4 mapping) and
+error paths."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from dist_mnist_tpu.data.idx import read_idx, write_idx
+
+DTYPES = [np.uint8, np.int8, np.int16, np.int32, np.float32, np.float64]
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    dtype=st.sampled_from(DTYPES),
+    shape=st.lists(st.integers(1, 6), min_size=1, max_size=4),
+    data=st.data(),
+    gz=st.booleans(),
+)
+def test_roundtrip(tmp_path_factory, dtype, shape, data, gz):
+    n = int(np.prod(shape))
+    if np.issubdtype(dtype, np.integer):
+        info = np.iinfo(dtype)
+        vals = data.draw(
+            st.lists(st.integers(info.min, info.max), min_size=n, max_size=n)
+        )
+    else:
+        vals = data.draw(
+            st.lists(
+                st.floats(-1e6, 1e6, allow_nan=False, width=32),
+                min_size=n,
+                max_size=n,
+            )
+        )
+    arr = np.array(vals, dtype=dtype).reshape(shape)
+    path = tmp_path_factory.mktemp("idx") / ("x.idx.gz" if gz else "x.idx")
+    write_idx(path, arr)
+    out = read_idx(path)
+    assert out.dtype == arr.dtype
+    assert out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_bad_magic(tmp_path):
+    p = tmp_path / "bad.idx"
+    p.write_bytes(b"\x01\x02\x08\x01\x00\x00\x00\x01\xff")
+    with pytest.raises(ValueError, match="magic"):
+        read_idx(p)
+
+
+def test_truncated(tmp_path):
+    p = tmp_path / "trunc.idx"
+    p.write_bytes(b"\x00\x00\x08\x01\x00\x00\x00\x05\x01\x02")
+    with pytest.raises(ValueError, match="truncated"):
+        read_idx(p)
+
+
+def test_unknown_dtype(tmp_path):
+    p = tmp_path / "odd.idx"
+    p.write_bytes(b"\x00\x00\x77\x01\x00\x00\x00\x01\x01")
+    with pytest.raises(ValueError, match="dtype"):
+        read_idx(p)

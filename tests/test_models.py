@@ -1,0 +1,89 @@
+"""Model init/apply contracts for the whole zoo."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_mnist_tpu.models import get_model
+from dist_mnist_tpu.utils import param_count
+
+CASES = [
+    ("mlp", (4, 28, 28, 1), {}),
+    ("lenet5", (4, 28, 28, 1), {}),
+    ("resnet20", (4, 32, 32, 3), {}),
+    ("vit_tiny", (4, 32, 32, 3), {"depth": 2}),  # shallow for test speed
+]
+
+
+@pytest.mark.parametrize("name,shape,kwargs", CASES)
+def test_init_apply_shapes(name, shape, kwargs, rng):
+    model = get_model(name, **kwargs)
+    x = jnp.zeros(shape, jnp.float32)
+    params, state = model.init(rng, x)
+    logits, new_state = model.apply(params, state, x, train=True, rng=rng)
+    assert logits.shape == (shape[0], 10)
+    assert logits.dtype == jnp.float32  # logits always f32 for the loss
+    logits_eval, _ = model.apply(params, state, x, train=False)
+    assert logits_eval.shape == (shape[0], 10)
+    assert jax.tree.structure(new_state) == jax.tree.structure(state)
+
+
+def test_mlp_reference_geometry(rng):
+    """Exact §0.1 shapes: hid_w [784,100], sm_w [100,10]."""
+    model = get_model("mlp", hidden_units=100)
+    params, _ = model.init(rng, jnp.zeros((1, 28, 28, 1)))
+    assert params["hid"]["w"].shape == (784, 100)
+    assert params["hid"]["b"].shape == (100,)
+    assert params["sm"]["w"].shape == (100, 10)
+    assert param_count(params) == 784 * 100 + 100 + 100 * 10 + 10
+    # truncated-normal stddev 1/sqrt(fan_in): bounded by 2*stddev
+    w = np.asarray(params["hid"]["w"])
+    assert np.abs(w).max() <= 2.0 / np.sqrt(784) + 1e-6
+    assert 0.5 / np.sqrt(784) < w.std() < 1.5 / np.sqrt(784)
+
+
+def test_lenet_param_count(rng):
+    """conv5x5x32 + conv5x5x64 + fc512 + fc10 (the classic tower)."""
+    model = get_model("lenet5")
+    params, _ = model.init(rng, jnp.zeros((1, 28, 28, 1)))
+    expected = (
+        (5 * 5 * 1 * 32 + 32)
+        + (5 * 5 * 32 * 64 + 64)
+        + (7 * 7 * 64 * 512 + 512)
+        + (512 * 10 + 10)
+    )
+    assert param_count(params) == expected
+
+
+def test_resnet_batchnorm_state_updates(rng):
+    model = get_model("resnet20")
+    x = jnp.ones((8, 32, 32, 3))
+    params, state = model.init(rng, x)
+    _, new_state = model.apply(params, state, x, train=True)
+    # running stats must move in train mode...
+    diff = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()), state, new_state)
+    assert max(jax.tree.leaves(diff)) > 0
+    # ...and stay frozen in eval mode
+    _, eval_state = model.apply(params, state, x, train=False)
+    same = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()), state, eval_state)
+    assert max(jax.tree.leaves(same)) == 0
+
+
+def test_vit_token_count(rng):
+    model = get_model("vit_tiny", depth=1)
+    params, _ = model.init(rng, jnp.zeros((1, 32, 32, 3)))
+    assert params["pos"].shape == (1, 65, 192)  # 64 patches + CLS
+
+
+def test_dropout_only_in_train(rng):
+    model = get_model("lenet5")
+    x = jnp.array(np.random.default_rng(0).normal(size=(4, 28, 28, 1)),
+                  jnp.float32)
+    params, state = model.init(rng, x)
+    a, _ = model.apply(params, state, x, train=False)
+    b, _ = model.apply(params, state, x, train=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c, _ = model.apply(params, state, x, train=True, rng=jax.random.PRNGKey(1))
+    d, _ = model.apply(params, state, x, train=True, rng=jax.random.PRNGKey(2))
+    assert (np.asarray(c) != np.asarray(d)).any()

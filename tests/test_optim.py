@@ -1,0 +1,134 @@
+"""Optimizer math: TF-Adam parity, transforms, sync semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_mnist_tpu import optim
+
+
+def _numpy_tf_adam(params, grads_seq, lr=0.01, b1=0.9, b2=0.999, eps=1e-8):
+    """Reference loop implementing training_ops.h ApplyAdam exactly."""
+    p = params.copy()
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    for t, g in enumerate(grads_seq, start=1):
+        lr_t = lr * np.sqrt(1 - b2**t) / (1 - b1**t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        p = p - lr_t * m / (np.sqrt(v) + eps)
+    return p
+
+
+def test_adam_matches_tf_semantics():
+    rng = np.random.default_rng(0)
+    p0 = rng.normal(size=(7,)).astype(np.float32)
+    grads = [rng.normal(size=(7,)).astype(np.float32) for _ in range(5)]
+    expected = _numpy_tf_adam(p0, grads)
+
+    opt = optim.adam(0.01)
+    params = {"w": jnp.asarray(p0)}
+    state = opt.init(params)
+    for g in grads:
+        updates, state = opt.update({"w": jnp.asarray(g)}, state, params)
+        params = optim.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(params["w"]), expected, rtol=1e-5)
+
+
+def test_adam_state_is_f32_even_for_bf16_grads():
+    opt = optim.adam(0.01)
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    state = opt.init(params)
+    updates, state = opt.update({"w": jnp.ones((3,), jnp.bfloat16)}, state, params)
+    assert state["m"]["w"].dtype == jnp.float32
+    assert updates["w"].dtype == jnp.float32
+
+
+def test_momentum_and_sgd_shapes():
+    for opt in (optim.sgd(0.1), optim.momentum(0.1, 0.9),
+                optim.momentum(0.1, 0.9, nesterov=True)):
+        params = {"a": jnp.ones((2, 2))}
+        state = opt.init(params)
+        updates, state = opt.update({"a": jnp.ones((2, 2))}, state, params)
+        new = optim.apply_updates(params, updates)
+        assert new["a"].shape == (2, 2)
+        assert float(jnp.abs(new["a"] - params["a"]).max()) > 0
+
+
+def test_clip_by_global_norm():
+    opt = optim.clip_by_global_norm(1.0)
+    g = {"a": jnp.full((4,), 10.0)}
+    updates, _ = opt.update(g, opt.init(g), g)
+    assert float(optim.global_norm(updates)) == pytest.approx(1.0, rel=1e-5)
+    small = {"a": jnp.full((4,), 0.01)}
+    updates, _ = opt.update(small, opt.init(small), small)
+    np.testing.assert_allclose(np.asarray(updates["a"]), 0.01, rtol=1e-5)
+
+
+def test_chain_order():
+    opt = optim.chain(optim.scale(2.0), optim.sgd(1.0))
+    params = {"a": jnp.zeros(())}
+    updates, _ = opt.update({"a": jnp.ones(())}, opt.init(params), params)
+    assert float(updates["a"]) == pytest.approx(-2.0)
+
+
+def test_gradient_accumulation_matches_large_batch():
+    """k accumulated microbatches == one update on the averaged gradient
+    (the replicas_to_aggregate mapping, optim/sync.py)."""
+    k = 4
+    rng = np.random.default_rng(1)
+    grads = [rng.normal(size=(5,)).astype(np.float32) for _ in range(k)]
+    mean_grad = np.mean(grads, axis=0)
+
+    base = optim.adam(0.01)
+    accum = optim.gradient_accumulation(optim.adam(0.01), every=k)
+
+    params = {"w": jnp.zeros((5,))}
+    # path A: k microbatch calls through the accumulator
+    sa = accum.init(params)
+    pa = params
+    intermediate = []
+    for g in grads:
+        updates, sa = accum.update({"w": jnp.asarray(g)}, sa, pa)
+        pa = optim.apply_updates(pa, updates)
+        intermediate.append(np.asarray(pa["w"]).copy())
+    # params must not move before the boundary (§3.4 worker view)
+    for snap in intermediate[:-1]:
+        np.testing.assert_array_equal(snap, 0.0)
+    # path B: one update with the averaged gradient
+    sb = base.init(params)
+    updates, sb = base.update({"w": jnp.asarray(mean_grad)}, sb, params)
+    pb = optim.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(pa["w"]), np.asarray(pb["w"]), rtol=1e-5)
+    # and the inner count advanced exactly once
+    assert int(sa["inner"]["count"]) == 1
+
+
+def test_gradient_accumulation_every_one_is_identity():
+    inner = optim.adam(0.01)
+    assert optim.gradient_accumulation(inner, 1) is inner
+
+
+def test_schedules():
+    from dist_mnist_tpu.optim import schedules
+
+    cos = schedules.cosine_decay(1.0, 100, warmup_steps=10)
+    assert float(cos(jnp.int32(0))) == pytest.approx(0.0)
+    assert float(cos(jnp.int32(10))) == pytest.approx(1.0, abs=1e-6)
+    assert float(cos(jnp.int32(100))) == pytest.approx(0.0, abs=1e-6)
+    step = schedules.step_decay(1.0, (10, 20), 0.1)
+    assert float(step(jnp.int32(5))) == pytest.approx(1.0)
+    assert float(step(jnp.int32(15))) == pytest.approx(0.1)
+    assert float(step(jnp.int32(25))) == pytest.approx(0.01, rel=1e-4)
+
+
+def test_adamw_decoupled_decay():
+    """adamw decay bypasses m/v normalization: for equal params and zero
+    grads, the update is exactly -lr*wd*p."""
+    opt = optim.adamw(0.1, weight_decay=0.5)
+    params = {"w": jnp.full((3,), 2.0)}
+    state = opt.init(params)
+    updates, _ = opt.update({"w": jnp.zeros((3,))}, state, params)
+    np.testing.assert_allclose(np.asarray(updates["w"]), -0.1 * 0.5 * 2.0,
+                               rtol=1e-6)

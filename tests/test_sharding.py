@@ -1,0 +1,93 @@
+"""Mesh construction + placement rules (replica_device_setter analogue)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import MeshSpec, local_batch_slice, make_mesh
+from dist_mnist_tpu.parallel.sharding import (
+    DP_RULES,
+    TP_RULES,
+    ShardingRules,
+    tree_sharding,
+)
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec(data=-1).resolve(8) == (8, 1, 1)
+    assert MeshSpec(data=-1, model=2).resolve(8) == (4, 2, 1)
+    assert MeshSpec(data=2, model=2, seq=2).resolve(8) == (2, 2, 2)
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=3).resolve(8)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(MeshSpec(data=4, model=2))
+    assert mesh.shape == {"data": 4, "model": 2, "seq": 1}
+    assert len(set(d.id for d in mesh.devices.flat)) == 8
+
+
+def test_local_batch_slice(mesh8):
+    per_proc, per_dev = local_batch_slice(64, mesh8)
+    assert per_proc == 64  # single process
+    assert per_dev == 8
+    with pytest.raises(ValueError):
+        local_batch_slice(65, mesh8)
+
+
+def test_dp_rules_replicate_everything(mesh8):
+    tree = {"layer": {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}}
+    shardings = tree_sharding(tree, mesh8, DP_RULES)
+    assert shardings["layer"]["w"].spec == P()
+    assert shardings["layer"]["b"].spec == P()
+
+
+def test_tp_rules_megatron_pattern(mesh_tp):
+    tree = {
+        "block0": {
+            "attn": {
+                "qkv": {"w": jnp.zeros((8, 24)), "b": jnp.zeros((24,))},
+                "out": {"w": jnp.zeros((8, 8)), "b": jnp.zeros((8,))},
+            },
+            "mlp_in": {"w": jnp.zeros((8, 32)), "b": jnp.zeros((32,))},
+            "mlp_out": {"w": jnp.zeros((32, 8)), "b": jnp.zeros((8,))},
+        }
+    }
+    s = tree_sharding(tree, mesh_tp, TP_RULES)
+    blk = s["block0"]
+    # column-parallel: output dim sharded
+    assert blk["attn"]["qkv"]["w"].spec == P(None, "model")
+    assert blk["attn"]["qkv"]["b"].spec == P("model")
+    assert blk["mlp_in"]["w"].spec == P(None, "model")
+    # row-parallel: input dim sharded, bias replicated
+    assert blk["attn"]["out"]["w"].spec == P("model", None)
+    assert blk["attn"]["out"]["b"].spec == P()
+    assert blk["mlp_out"]["w"].spec == P("model", None)
+    assert blk["mlp_out"]["b"].spec == P()
+
+
+def test_custom_rule_ordering():
+    rules = ShardingRules(rules=(
+        (r"special/w$", ("data",)),
+        (r"w$", ("model",)),
+    ))
+    assert rules.spec_for("special/w", 1) == P("data")
+    assert rules.spec_for("other/w", 1) == P("model")
+    assert rules.spec_for("other/b", 1) == P()
+
+
+def test_opt_state_inherits_param_specs(mesh_tp):
+    """Adam m/v mirror params structurally, so the same path rules colocate
+    slot shards with param shards (PS slot-colocation analogue)."""
+    from dist_mnist_tpu import optim
+
+    params = {"mlp_in": {"w": jnp.zeros((8, 32)), "b": jnp.zeros((32,))}}
+    opt_state = optim.adam(0.01).init(params)
+    s = tree_sharding({"opt": opt_state}, mesh_tp, TP_RULES)
+    assert s["opt"]["m"]["mlp_in"]["w"].spec == P(None, "model")
+    assert s["opt"]["v"]["mlp_in"]["w"].spec == P(None, "model")
+    assert s["opt"]["count"].spec == P()

@@ -1,0 +1,139 @@
+"""The compiled SPMD step: correctness of the implicit all-reduce
+(DP result == single-device result), donation, metrics, eval."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dist_mnist_tpu import optim
+from dist_mnist_tpu.data.pipeline import shard_batch
+from dist_mnist_tpu.models import get_model
+from dist_mnist_tpu.parallel.sharding import shard_train_state
+from dist_mnist_tpu.train import (
+    create_train_state,
+    evaluate,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def _setup(mesh, batch=32, seed=0):
+    model = get_model("mlp", hidden_units=32)
+    opt = optim.adam(0.01)
+    rng = np.random.default_rng(seed)
+    batch_np = {
+        "image": rng.integers(0, 255, (batch, 28, 28, 1), dtype=np.uint8),
+        "label": rng.integers(0, 10, (batch,), dtype=np.int32),
+    }
+    with mesh:
+        state = create_train_state(model, opt, jax.random.PRNGKey(seed),
+                                   batch_np["image"][:1])
+        state = shard_train_state(state, mesh)
+        step = make_train_step(model, opt, mesh, donate=False)
+        dev_batch = shard_batch(batch_np, mesh)
+    return model, opt, state, step, dev_batch, batch_np
+
+
+def test_loss_decreases(mesh8):
+    _, _, state, step, batch, _ = _setup(mesh8)
+    with mesh8:
+        losses = []
+        for _ in range(20):
+            state, out = step(state, batch)
+            losses.append(float(out["loss"]))
+    assert losses[-1] < losses[0] * 0.5
+    assert int(state.step_int) == 20
+
+
+def test_dp_matches_single_device(mesh8, mesh1):
+    """8-way data-parallel must equal 1-device training on the same global
+    batch — the correctness contract of replacing the PS push/pull with the
+    in-step all-reduce (SURVEY.md §2.6 row 'DP sync')."""
+    _, _, s8, step8, b8, batch_np = _setup(mesh8)
+    _, _, s1, step1, _, _ = _setup(mesh1)
+    with mesh1:
+        b1 = shard_batch(batch_np, mesh1)
+    for _ in range(5):
+        with mesh8:
+            s8, o8 = step8(s8, b8)
+        with mesh1:
+            s1, o1 = step1(s1, b1)
+    np.testing.assert_allclose(float(o8["loss"]), float(o1["loss"]),
+                               rtol=2e-5, atol=1e-6)
+    w8 = np.asarray(s8.params["hid"]["w"])
+    w1 = np.asarray(s1.params["hid"]["w"])
+    np.testing.assert_allclose(w8, w1, rtol=2e-4, atol=2e-6)
+
+
+def test_metrics_replicated_scalars(mesh8):
+    _, _, state, step, batch, _ = _setup(mesh8)
+    with mesh8:
+        _, out = step(state, batch)
+    assert out["loss"].shape == ()
+    assert out["accuracy"].shape == ()
+    assert 0.0 <= float(out["accuracy"]) <= 1.0
+
+
+def test_donation(mesh8):
+    model = get_model("mlp", hidden_units=32)
+    opt = optim.adam(0.01)
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "image": rng.integers(0, 255, (32, 28, 28, 1), dtype=np.uint8),
+        "label": rng.integers(0, 10, (32,), dtype=np.int32),
+    }
+    with mesh8:
+        state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                   batch_np["image"][:1])
+        state = shard_train_state(state, mesh8)
+        step = make_train_step(model, opt, mesh8, donate=True)
+        batch = shard_batch(batch_np, mesh8)
+        new_state, _ = step(state, batch)
+    # the old state's buffers were donated into the new state
+    assert state.params["hid"]["w"].is_deleted()
+    assert not new_state.params["hid"]["w"].is_deleted()
+
+
+def test_evaluate_full_set_with_padding(mesh8, small_mnist):
+    model = get_model("mlp", hidden_units=32)
+    opt = optim.adam(0.01)
+    with mesh8:
+        state = create_train_state(
+            model, opt, jax.random.PRNGKey(0), small_mnist.train_images[:1]
+        )
+        state = shard_train_state(state, mesh8)
+        eval_step = make_eval_step(model, mesh8)
+        # 512 test rows, batch 200 -> tail of 112 exercises the pad/mask path
+        res = evaluate(eval_step, state, small_mnist.test_images,
+                       small_mnist.test_labels, mesh8, batch_size=200)
+    assert res["n"] == 512
+    assert 0.0 <= res["accuracy"] <= 1.0
+    # untrained model ≈ chance; padding bug would skew this wildly
+    assert res["loss"] > 1.0
+
+
+def test_clipped_loss_parity_path(mesh8):
+    """The reference loss (clipped CE) trains too (config 1 uses it)."""
+    from dist_mnist_tpu.ops import losses
+
+    model = get_model("mlp", hidden_units=32)
+    opt = optim.adam(0.01)
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "image": rng.integers(0, 255, (64, 28, 28, 1), dtype=np.uint8),
+        "label": rng.integers(0, 10, (64,), dtype=np.int32),
+    }
+    with mesh8:
+        state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                   batch_np["image"][:1])
+        state = shard_train_state(state, mesh8)
+        step = make_train_step(model, opt, mesh8,
+                               loss_fn=losses.clipped_softmax_cross_entropy,
+                               donate=False)
+        batch = shard_batch(batch_np, mesh8)
+        first = last = None
+        for _ in range(10):
+            state, out = step(state, batch)
+            last = float(out["loss"])
+            first = first if first is not None else last
+    assert last < first
