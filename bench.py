@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Benchmark driver — prints ONE JSON line for the harness.
+
+Headline metric (BASELINE.md): LeNet-5 (the "MNIST CNN") steps/sec/chip at
+the reference's original dist-config geometry (global batch 200 = 2 workers
+x 100 — SURVEY.md §0.1). The run uses the fused-input step
+(train/step.make_fused_train_step): dataset resident in HBM, batch sampling
+compiled into the step, zero host work per step — the polar opposite of the
+reference's per-step feed_dict -> gRPC -> PS round-trip (§3.3).
+
+`vs_baseline`: the reference publishes no steps/sec numbers
+(BASELINE.json `published: {}`), so the only authoritative target is the
+north star "≥99% MNIST test accuracy in <60 s wall-clock". We time the
+accuracy race (training start -> first eval ≥99%, compile included) and
+report vs_baseline = 60s / wall_to_99 (>1 = beating the target).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+
+
+def main():
+    # persistent XLA compile cache: repeat invocations skip the ~45 s of
+    # scan/init/eval compiles entirely (cold-compile time still counts
+    # against wall_to_99 on the first run — reported honestly either way)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.data import DeviceDataset, load_dataset
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state, evaluate, make_eval_step
+    from dist_mnist_tpu.train.step import make_scanned_train_fn
+
+    n_chips = jax.device_count()
+    mesh = make_mesh(MeshSpec(data=-1))
+    dataset = load_dataset("mnist", "/tmp/mnist-data", seed=0)
+    model = get_model("lenet5")
+    optimizer = optim.adam(1e-3)
+    batch = 200  # reference dist config: 2 workers x batch 100
+
+    t_start = time.monotonic()
+    with mesh:
+        state = create_train_state(
+            model, optimizer, jax.random.PRNGKey(0), dataset.train_images[:1]
+        )
+        state = shard_train_state(state, mesh)
+        dd = DeviceDataset(dataset, mesh)
+        chunk = 100  # steps per compiled scan: no per-step dispatch at all
+        run = make_scanned_train_fn(model, optimizer, mesh, dd, batch, chunk)
+        eval_step = make_eval_step(model, mesh)
+
+        # --- accuracy race: train to 99% test acc, wall-clock from start ---
+        wall_to_99 = None
+        for rounds in range(40):  # 40 x 2 x 100 = up to 8000 steps
+            for _ in range(2):
+                state, out = run(state)
+            res = evaluate(
+                eval_step, state, dataset.test_images, dataset.test_labels,
+                mesh, batch_size=10_000,  # one dispatch for the whole test set
+            )
+            if res["accuracy"] >= 0.99:
+                wall_to_99 = time.monotonic() - t_start
+                break
+
+        # --- steady-state throughput (post-compile, post-warmup) ---
+        state, out = run(state)
+        jax.block_until_ready(out["loss"])
+        n_timed = 2000
+        t0 = time.monotonic()
+        for _ in range(n_timed // chunk):
+            state, out = run(state)
+        jax.block_until_ready(out["loss"])
+        dt = time.monotonic() - t0
+
+    steps_per_sec_per_chip = n_timed / dt / n_chips
+    result = {
+        "metric": "lenet5_mnist_steps_per_sec_per_chip",
+        "value": round(steps_per_sec_per_chip, 2),
+        "unit": "steps/sec/chip",
+        # >1.0 = beat the ≥99%-in-<60s north star; reference publishes no
+        # throughput numbers (BASELINE.json published={})
+        "vs_baseline": round(60.0 / wall_to_99, 2) if wall_to_99 else 0.0,
+        "extra": {
+            "chips": n_chips,
+            "global_batch": batch,
+            "examples_per_sec": round(steps_per_sec_per_chip * n_chips * batch),
+            "wall_to_99pct_acc_secs": round(wall_to_99, 2) if wall_to_99 else None,
+            "final_test_acc": round(res["accuracy"], 4),
+            "synthetic_data": dataset.synthetic,
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,7 @@ from dist_mnist_tpu.cluster.mesh import (
     ClusterConfig,
     MeshSpec,
     make_mesh,
+    activate,
     local_batch_slice,
     device_count,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "ClusterConfig",
     "MeshSpec",
     "make_mesh",
+    "activate",
     "local_batch_slice",
     "device_count",
     "initialize_distributed",

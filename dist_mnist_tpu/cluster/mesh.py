@@ -132,6 +132,15 @@ def local_batch_slice(global_batch: int, mesh: Mesh) -> tuple[int, int]:
     return global_batch // n_proc, per_device
 
 
+def activate(mesh: Mesh):
+    """Context manager installing `mesh` as the ambient mesh
+    (`jax.set_mesh`): mesh-adaptive code (parallel/ring_attention.ring_
+    attention) discovers it via `jax.sharding.get_abstract_mesh()`, and raw
+    PartitionSpecs become accepted wherever a sharding is expected. The
+    plain `with mesh:` context does NOT set the abstract mesh — use this."""
+    return jax.set_mesh(mesh)
+
+
 def validate_mesh(mesh: Mesh) -> None:
     n = math.prod(mesh.devices.shape)
     if n != len(np.unique([d.id for d in mesh.devices.flat])):

@@ -110,23 +110,86 @@ def _synth(name: str, n_train: int, n_test: int, seed: int):
     return {"train_x": tx, "train_y": ty, "test_x": vx, "test_y": vy}
 
 
+def _cache_paths(data_dir: Path, name: str):
+    if name == "cifar10":
+        return None  # cached as npz below
+    prefix = "" if name == "mnist" else f"{name}."
+    return {k: data_dir / f"{prefix}{v}" for k, v in _MNIST_FILES.items()}
+
+
+def _write_synth_cache(data_dir: Path, name: str, raw: dict) -> None:
+    """Persist the synthesized twin in the dataset's canonical on-disk
+    format so later runs (and other tools) load instead of regenerate
+    (~15 s for 60k MNIST images) — the analogue of read_data_sets' download
+    cache in --data_dir."""
+    from dist_mnist_tpu.data.idx import write_idx
+
+    data_dir.mkdir(parents=True, exist_ok=True)
+    if name == "cifar10":
+        np.savez(data_dir / "cifar10_synth.npz", **raw)
+        return
+    paths = _cache_paths(data_dir, name)
+    write_idx(paths["train_x"], raw["train_x"][..., 0])
+    write_idx(paths["train_y"], raw["train_y"].astype(np.uint8))
+    write_idx(paths["test_x"], raw["test_x"][..., 0])
+    write_idx(paths["test_y"], raw["test_y"].astype(np.uint8))
+
+
+def _load_fashion_or_mnist(data_dir: Path, name: str):
+    """IDX quad; fashion files carry a `fashion_mnist.` prefix so both
+    datasets can share one directory."""
+    if name == "mnist":
+        return _load_idx_quad(data_dir)
+    paths = _cache_paths(data_dir, name)
+    if not all(p.exists() or p.with_suffix(p.suffix + ".gz").exists()
+               for p in paths.values()):
+        return None
+    from dist_mnist_tpu.data.idx import read_idx
+
+    out = {k: read_idx(p if p.exists() else p.with_suffix(p.suffix + ".gz"))
+           for k, p in paths.items()}
+    out["train_x"] = out["train_x"][..., None]
+    out["test_x"] = out["test_x"][..., None]
+    return out
+
+
+def _load_cifar10(data_dir: Path):
+    npz = data_dir / "cifar10_synth.npz"
+    if npz.exists():
+        with np.load(npz) as z:
+            return {k: z[k] for k in ("train_x", "train_y", "test_x", "test_y")}
+    return _load_cifar10_dir(data_dir)
+
+
 def load_dataset(
     name: str,
     data_dir: str | Path = "/tmp/mnist-data",
     *,
     seed: int = 0,
     synthetic_sizes: tuple[int, int] = (60_000, 10_000),
+    cache_synthetic: bool = True,
 ) -> Dataset:
-    """Load `name` from data_dir, else synthesize its procedural twin."""
+    """Load `name` from data_dir, else synthesize its procedural twin (and
+    cache it to data_dir in the canonical on-disk format)."""
     if name not in DATASETS:
         raise KeyError(f"unknown dataset {name!r}; have {sorted(DATASETS)}")
     data_dir = Path(data_dir)
-    loader = _load_cifar10_dir if name == "cifar10" else _load_idx_quad
-    raw = loader(data_dir) if data_dir.exists() else None
+    raw = None
+    if data_dir.exists():
+        raw = (
+            _load_cifar10(data_dir)
+            if name == "cifar10"
+            else _load_fashion_or_mnist(data_dir, name)
+        )
     is_synth = raw is None
     if is_synth:
         log.warning("%s not found under %s — using synthetic twin", name, data_dir)
         raw = _synth(name, *synthetic_sizes, seed)
+        if cache_synthetic and synthetic_sizes == (60_000, 10_000):
+            try:
+                _write_synth_cache(data_dir, name, raw)
+            except OSError as e:  # read-only data_dir is fine
+                log.info("could not cache synthetic %s: %s", name, e)
     return Dataset(
         name=name,
         train_images=np.ascontiguousarray(raw["train_x"]),

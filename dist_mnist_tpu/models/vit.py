@@ -30,21 +30,26 @@ class ViTTiny:
     dropout_rate: float = 0.1
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "xla"  # "xla" | "flash" | "ring"
+    pool: str = "cls"  # "cls" | "mean" (mean keeps token count a power of
+    # two — required when the sequence dim is sharded, e.g. ring attention)
 
     def init(self, rng, sample_input):
         h, w, c = (int(d) for d in sample_input.shape[1:])
-        n_tokens = (h // self.patch) * (w // self.patch) + 1  # + CLS
+        n_tokens = (h // self.patch) * (w // self.patch)
+        if self.pool == "cls":
+            n_tokens += 1
         keys = jax.random.split(rng, 4 + self.depth)
         d = self.dim
         params: dict = {
             "patch": nn.init_conv(keys[0], self.patch, self.patch,
                                   c, d, init=nn.xavier_uniform),
             "pos": 0.02 * jax.random.normal(keys[1], (1, n_tokens, d)),
-            "cls": jnp.zeros((1, 1, d)),
             "head": nn.init_dense(keys[2], d, self.num_classes,
                                   init=nn.xavier_uniform),
             "final_ln": nn.init_layer_norm(d),
         }
+        if self.pool == "cls":
+            params["cls"] = jnp.zeros((1, 1, d))
         for i in range(self.depth):
             k1, k2, k3 = jax.random.split(keys[3 + i], 3)
             params[f"block{i}"] = {
@@ -85,8 +90,9 @@ class ViTTiny:
         x = nn.conv2d(params["patch"], x, stride=self.patch, padding="VALID")
         b, ph, pw, d = x.shape
         x = x.reshape(b, ph * pw, d)
-        cls = jnp.broadcast_to(params["cls"].astype(x.dtype), (b, 1, d))
-        x = jnp.concatenate([cls, x], axis=1)
+        if self.pool == "cls":
+            cls = jnp.broadcast_to(params["cls"].astype(x.dtype), (b, 1, d))
+            x = jnp.concatenate([cls, x], axis=1)
         x = x + params["pos"].astype(x.dtype)
         if train and rng is not None:
             rngs = jax.random.split(rng, self.depth)
@@ -100,5 +106,6 @@ class ViTTiny:
                 y = nn.dropout(rngs[i], y, self.dropout_rate, train=True)
             x = x + nn.dense(p["mlp_out"], y)
         x = nn.layer_norm(params["final_ln"], x)
-        logits = nn.dense(params["head"], x[:, 0])
+        pooled = x[:, 0] if self.pool == "cls" else jnp.mean(x, axis=1)
+        logits = nn.dense(params["head"], pooled)
         return logits.astype(jnp.float32), state

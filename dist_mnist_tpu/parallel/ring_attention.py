@@ -77,10 +77,13 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
-    """shard_map wrapper: shards the sequence dim (1) of [B,S,H,D] over
-    `axis_name` and runs the ring. Batch/heads stay as-is (combine with
-    `data`/`model` sharding freely — the specs only constrain dim 1)."""
-    spec = P(None, axis_name, None, None)
+    """shard_map wrapper over [B,S,H,D]: batch stays sharded over `data`,
+    heads over `model`, and the sequence dim rings over `axis_name` — the
+    full hybrid DP x TP x SP layout in one spec. Requires B % data == 0,
+    H % model == 0, S % seq == 0."""
+    from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
+
+    spec = P(DATA_AXIS, axis_name, MODEL_AXIS, None)
     fn = jax.shard_map(
         partial(ring_attention_inner, axis_name=axis_name),
         mesh=mesh,

@@ -36,7 +36,9 @@ def ulysses_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
 
 
 def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
-    spec = P(None, axis_name, None, None)
+    from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+
+    spec = P(DATA_AXIS, axis_name, None, None)
     fn = jax.shard_map(
         partial(ulysses_attention_inner, axis_name=axis_name),
         mesh=mesh,

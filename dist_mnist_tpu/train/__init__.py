@@ -8,13 +8,19 @@ executors -> rendezvous RecvTensor) becomes ONE jit-compiled XLA program
 """
 
 from dist_mnist_tpu.train.state import TrainState, create_train_state
-from dist_mnist_tpu.train.step import make_train_step, make_eval_step, evaluate
+from dist_mnist_tpu.train.step import (
+    make_train_step,
+    make_fused_train_step,
+    make_eval_step,
+    evaluate,
+)
 from dist_mnist_tpu.train.loop import TrainLoop, StopSignal
 
 __all__ = [
     "TrainState",
     "create_train_state",
     "make_train_step",
+    "make_fused_train_step",
     "make_eval_step",
     "evaluate",
     "TrainLoop",
