@@ -59,6 +59,9 @@ flags.DEFINE_boolean("profile", False, "trace a window of steps to logdir")
 flags.DEFINE_integer("eval_every", None, "eval cadence in steps; 0 disables "
                      "(None = config value)")
 flags.DEFINE_integer("log_every", None, "log/summary cadence in steps")
+flags.DEFINE_enum("input_pipeline", "python", ["python", "native"],
+                  "batcher implementation: python (numpy) or native "
+                  "(C++ prefetch ring, data/native)")
 flags.DEFINE_integer("max_recoveries", 3,
                      "preemption restore attempts (needs checkpoint_dir)")
 
@@ -110,6 +113,7 @@ def run_config(
     max_recoveries: int = 0,
     extra_hooks=(),
     mesh=None,
+    input_pipeline: str = "python",
 ):
     """Programmatic entrypoint (tests/bench call this; main() parses flags).
 
@@ -192,7 +196,14 @@ def run_config(
             hooks.append(hooks_lib.ProfilerHook(logdir))
         hooks.extend(extra_hooks)
 
-        batches = ShardedBatcher(dataset, cfg.batch_size, mesh, seed=cfg.seed)
+        if input_pipeline == "native":
+            from dist_mnist_tpu.data.native import NativeBatcher
+
+            batches = NativeBatcher(dataset, cfg.batch_size, mesh,
+                                    seed=cfg.seed)
+        else:
+            batches = ShardedBatcher(dataset, cfg.batch_size, mesh,
+                                     seed=cfg.seed)
         loop = TrainLoop(
             step_fn,
             state,
@@ -286,6 +297,7 @@ def main(argv):
         logdir=FLAGS.logdir,
         profile=FLAGS.profile,
         max_recoveries=FLAGS.max_recoveries if FLAGS.checkpoint_dir else 0,
+        input_pipeline=FLAGS.input_pipeline,
     )
 
 

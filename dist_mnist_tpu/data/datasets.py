@@ -117,22 +117,43 @@ def _cache_paths(data_dir: Path, name: str):
     return {k: data_dir / f"{prefix}{v}" for k, v in _MNIST_FILES.items()}
 
 
+def _synth_marker(data_dir: Path, name: str) -> Path:
+    return data_dir / f".{name}.synthetic-twin"
+
+
 def _write_synth_cache(data_dir: Path, name: str, raw: dict) -> None:
     """Persist the synthesized twin in the dataset's canonical on-disk
     format so later runs (and other tools) load instead of regenerate
     (~15 s for 60k MNIST images) — the analogue of read_data_sets' download
-    cache in --data_dir."""
+    cache in --data_dir. Writes are atomic (tmp + rename) so an interrupted
+    or concurrent run can never leave a torn file behind, and a marker file
+    records that these files are procedural, not the real dataset."""
+    import os
+
     from dist_mnist_tpu.data.idx import write_idx
 
     data_dir.mkdir(parents=True, exist_ok=True)
+
+    def atomic(path: Path, write_fn):
+        tmp = path.with_name(path.name + f".tmp{os.getpid()}")
+        try:
+            write_fn(tmp)
+            os.replace(tmp, path)
+        finally:
+            tmp.unlink(missing_ok=True)
+
     if name == "cifar10":
-        np.savez(data_dir / "cifar10_synth.npz", **raw)
-        return
-    paths = _cache_paths(data_dir, name)
-    write_idx(paths["train_x"], raw["train_x"][..., 0])
-    write_idx(paths["train_y"], raw["train_y"].astype(np.uint8))
-    write_idx(paths["test_x"], raw["test_x"][..., 0])
-    write_idx(paths["test_y"], raw["test_y"].astype(np.uint8))
+        atomic(data_dir / "cifar10_synth.npz",
+               lambda p: np.savez(p.open("wb"), **raw))
+    else:
+        paths = _cache_paths(data_dir, name)
+        atomic(paths["train_x"], lambda p: write_idx(p, raw["train_x"][..., 0]))
+        atomic(paths["train_y"],
+               lambda p: write_idx(p, raw["train_y"].astype(np.uint8)))
+        atomic(paths["test_x"], lambda p: write_idx(p, raw["test_x"][..., 0]))
+        atomic(paths["test_y"],
+               lambda p: write_idx(p, raw["test_y"].astype(np.uint8)))
+    _synth_marker(data_dir, name).touch()
 
 
 def _load_fashion_or_mnist(data_dir: Path, name: str):
@@ -176,13 +197,23 @@ def load_dataset(
     data_dir = Path(data_dir)
     raw = None
     if data_dir.exists():
-        raw = (
-            _load_cifar10(data_dir)
-            if name == "cifar10"
-            else _load_fashion_or_mnist(data_dir, name)
-        )
-    is_synth = raw is None
-    if is_synth:
+        try:
+            raw = (
+                _load_cifar10(data_dir)
+                if name == "cifar10"
+                else _load_fashion_or_mnist(data_dir, name)
+            )
+        except (ValueError, OSError) as e:
+            # torn/corrupt files (e.g. a cache write that raced an old
+            # non-atomic writer) must not brick training — resynthesize
+            log.warning("unreadable %s under %s (%s); falling back to "
+                        "synthesis", name, data_dir, e)
+            raw = None
+    # files written by _write_synth_cache are procedural — keep the flag
+    # true on cache reloads (the marker), but only regenerate when no
+    # readable files exist at all
+    is_synth = raw is None or _synth_marker(data_dir, name).exists()
+    if raw is None:
         log.warning("%s not found under %s — using synthetic twin", name, data_dir)
         raw = _synth(name, *synthetic_sizes, seed)
         if cache_synthetic and synthetic_sizes == (60_000, 10_000):

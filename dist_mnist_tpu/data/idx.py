@@ -43,7 +43,10 @@ def read_idx(path: str | Path) -> np.ndarray:
         code, ndim = header[2], header[3]
         if code not in _DTYPES:
             raise ValueError(f"{path}: unknown IDX dtype code 0x{code:02x}")
-        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        dims_raw = f.read(4 * ndim)
+        if len(dims_raw) != 4 * ndim:
+            raise ValueError(f"{path}: truncated IDX header")
+        dims = struct.unpack(f">{ndim}I", dims_raw)
         dtype = _DTYPES[code]
         count = int(np.prod(dims, dtype=np.int64)) if ndim else 1
         raw = f.read(count * dtype.itemsize)

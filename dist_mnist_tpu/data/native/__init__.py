@@ -1,0 +1,10 @@
+"""Native (C++) input pipeline: background batch assembly + prefetch ring.
+
+See loader.cc for the design; `NativeBatcher` is the drop-in alternative to
+`pipeline.ShardedBatcher` with host-side gather moved off the critical path
+onto a C++ producer thread. Falls back is the caller's choice — construction
+raises if the toolchain is unavailable."""
+
+from dist_mnist_tpu.data.native.batcher import NativeBatcher, build_library
+
+__all__ = ["NativeBatcher", "build_library"]

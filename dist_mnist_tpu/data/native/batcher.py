@@ -1,0 +1,106 @@
+"""ctypes wrapper over the native prefetching loader."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+from pathlib import Path
+
+import numpy as np
+
+from dist_mnist_tpu.utils.native_build import build_shared_lib, load_lib
+
+log = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "loader.cc"
+_LIB = Path(__file__).parent / "libloader.so"
+
+
+def build_library(force: bool = False) -> Path:
+    return build_shared_lib(_SRC, _LIB, force=force)
+
+
+def _get_lib():
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64 = ctypes.c_int64
+    return load_lib(_SRC, _LIB, {
+        "loader_create": ([u8p, i32p, i64, i64, i64, ctypes.c_uint64,
+                           ctypes.c_int, i64, i64], ctypes.c_void_p),
+        "loader_next": ([ctypes.c_void_p, u8p, i32p], i64),
+        "loader_close": ([ctypes.c_void_p], None),
+        "loader_destroy": ([ctypes.c_void_p], None),
+    })
+
+
+class NativeBatcher:
+    """Deterministic shuffled epochs, assembled+prefetched in C++.
+
+    Multi-host: every process sees the same permutation (seeded shuffle in
+    the library) and extracts its own disjoint slice of each global batch
+    (slice_begin/slice_size), mirroring ShardedBatcher's contract. Iterating
+    yields device-sharded batches via pipeline.shard_batch.
+    """
+
+    def __init__(self, dataset, global_batch: int, mesh, *, seed: int = 0,
+                 prefetch_depth: int = 4):
+        import jax
+
+        n = dataset.train_images.shape[0]
+        if global_batch > n:
+            raise ValueError(f"global batch {global_batch} > dataset {n}")
+        n_proc, pid = jax.process_count(), jax.process_index()
+        if global_batch % n_proc:
+            raise ValueError("global batch must divide across processes")
+        self.local = global_batch // n_proc
+        # keep references so the C++ side's borrowed pointers stay alive
+        self._images = np.ascontiguousarray(dataset.train_images)
+        self._labels = np.ascontiguousarray(dataset.train_labels, np.int32)
+        self._row_bytes = int(self._images[0].nbytes)
+        self._img_shape = self._images.shape[1:]
+        self.mesh = mesh
+        lib = _get_lib()
+        self._lib = lib
+        self._h = lib.loader_create(
+            self._images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n, self._row_bytes, global_batch, seed, prefetch_depth,
+            pid * self.local, self.local,
+        )
+        if not self._h:
+            raise RuntimeError("loader_create failed (bad batch/depth)")
+
+    def next_local(self) -> tuple[np.ndarray, np.ndarray, int]:
+        """(images uint8 [local,...], labels int32 [local], step) — host."""
+        img = np.empty((self.local, *self._img_shape), np.uint8)
+        lab = np.empty((self.local,), np.int32)
+        step = self._lib.loader_next(
+            self._h,
+            img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            lab.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if step < 0:
+            raise StopIteration
+        return img, lab, int(step)
+
+    def __iter__(self):
+        from dist_mnist_tpu.data.pipeline import shard_batch
+
+        while True:
+            try:
+                img, lab, _ = self.next_local()
+            except StopIteration:
+                return
+            yield shard_batch({"image": img, "label": lab}, self.mesh)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.loader_close(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.loader_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

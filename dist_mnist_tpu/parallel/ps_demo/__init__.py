@@ -1,0 +1,24 @@
+"""Async/sync parameter-server DEMO — the protocol the TPU path replaced.
+
+The reference's default mode is asynchronous parameter-server data
+parallelism (SURVEY.md §2.6 row 1) which is architecturally out-of-model for
+a lockstep SPMD program (§7 hard part (b)). Per the survey's build plan
+(§7 step 6), this package is the one place native code re-creates the PS
+protocol itself: a C++ parameter server (`ps_server.cc`) holding the flat
+master weights + Adam slots, with the ConditionalAccumulator staleness/
+aggregation state machine and the FIFO token-queue barrier, driven by
+Python worker THREADS that compute real gradients with JAX on CPU.
+
+This is an educational/parity artifact: `python -m
+dist_mnist_tpu.parallel.ps_demo` trains the reference MLP both ways and
+prints the steps/sec + staleness profile, so the README's "what did the
+TPU rebuild actually delete?" section has a live exhibit.
+"""
+
+from dist_mnist_tpu.parallel.ps_demo.bindings import (
+    ParameterServer,
+    build_library,
+)
+from dist_mnist_tpu.parallel.ps_demo.demo import run_demo
+
+__all__ = ["ParameterServer", "build_library", "run_demo"]

@@ -1,0 +1,155 @@
+"""Live reenactment of `dist_mnist.py --job_name={ps,worker}` on one host.
+
+Topology parity with SURVEY.md §0.1 / §3.3 / §3.4, minus gRPC (the PS lives
+in-process behind ctypes instead of behind a socket — the protocol and
+blocking structure are identical):
+
+- the C++ ParameterServer plays the `ps` job (variables + Adam slots +
+  accumulators + token queue, all native — rows 8-12),
+- each Python thread plays a `worker` job: pull params, compute gradients
+  on its own batch stream (real JAX autodiff on CPU), push,
+- async mode: push applies immediately; staleness tolerated/bounded,
+- sync mode (`--sync_replicas`): pushes feed the accumulator; worker 0
+  doubles as chief running the aggregate->apply->token loop; workers block
+  on the token queue (the §3.4 barrier).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+
+def run_demo(
+    mode: str = "async",
+    num_workers: int = 2,
+    train_steps: int = 200,
+    batch_size: int = 100,
+    hidden_units: int = 100,
+    lr: float = 0.01,
+    dataset=None,
+    seed: int = 0,
+) -> dict:
+    """Train the reference MLP through the native PS. Returns metrics."""
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from dist_mnist_tpu.data.datasets import load_dataset
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.ops import losses
+    from dist_mnist_tpu.parallel.ps_demo.bindings import ParameterServer
+
+    if mode not in ("async", "sync"):
+        raise ValueError(f"mode must be async|sync, got {mode!r}")
+    cpu = jax.devices("cpu")[0]
+    dataset = dataset or load_dataset(
+        "mnist", "/tmp/mnist-data", seed=seed, synthetic_sizes=(8192, 1024)
+    )
+    model = get_model("mlp", hidden_units=hidden_units)
+
+    with jax.default_device(cpu):
+        params0, _ = model.init(
+            jax.random.PRNGKey(seed), dataset.train_images[:1]
+        )
+        flat0, unravel = ravel_pytree(params0)
+
+        @jax.jit
+        def grad_fn(flat_params, x, y):
+            def loss_of(flat):
+                logits, _ = model.apply(unravel(flat), {}, x, train=False)
+                return losses.clipped_softmax_cross_entropy(logits, y)
+
+            return jax.grad(loss_of)(flat_params)
+
+        @jax.jit
+        def acc_fn(flat_params, x, y):
+            logits, _ = model.apply(unravel(flat_params), {}, x, train=False)
+            return jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+
+    sizes = [flat0.size]
+    ps = ParameterServer(
+        sizes,
+        lr=lr,
+        replicas_to_aggregate=num_workers if mode == "sync" else 0,
+        staleness_bound=2 * num_workers if mode == "async" else -1,
+    )
+    ps.init(np.asarray(flat0))
+
+    images = dataset.normalized(dataset.train_images)
+    labels = dataset.train_labels
+    n = images.shape[0]
+    stop = threading.Event()
+    applied_counts = [0] * num_workers
+
+    def worker(widx: int):
+        rng = np.random.default_rng(seed * 100 + widx)
+        with jax.default_device(cpu):
+            while not stop.is_set() and ps.step < train_steps:
+                flat, pulled_step = ps.pull()  # weight pull (RecvTensor read)
+                idx = rng.integers(0, n, batch_size)
+                g = np.asarray(
+                    grad_fn(jnp.asarray(flat), images[idx], labels[idx])
+                )
+                if mode == "async":
+                    if ps.push_async(g, pulled_step):
+                        applied_counts[widx] += 1
+                else:
+                    ps.push_sync(g, pulled_step)  # may be dropped as stale
+                    token = ps.dequeue_token()  # §3.4 barrier
+                    if token < 0:
+                        break
+                    applied_counts[widx] += 1
+
+    def chief():
+        # the chief-only QueueRunner thread (queue_runner_impl.py:236)
+        while not stop.is_set() and ps.step < train_steps:
+            if ps.chief_sync_once(tokens_per_step=num_workers) < 0:
+                break
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(num_workers)
+    ]
+    if mode == "sync":
+        threads.append(threading.Thread(target=chief, daemon=True))
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    while ps.step < train_steps and any(t.is_alive() for t in threads):
+        time.sleep(0.01)
+    stop.set()
+    ps.close()
+    for t in threads:
+        t.join(timeout=5)
+    elapsed = time.monotonic() - t0
+
+    final_flat, final_step = ps.pull()
+    with jax.default_device(cpu):
+        test_acc = float(
+            acc_fn(
+                jnp.asarray(final_flat),
+                jnp.asarray(dataset.normalized(dataset.test_images)),
+                jnp.asarray(dataset.test_labels),
+            )
+        )
+    return {
+        "mode": mode,
+        "global_step": final_step,
+        "steps_per_sec": final_step / elapsed,
+        "test_accuracy": test_acc,
+        "dropped_stale_grads": ps.dropped,
+        "per_worker_applies": applied_counts,
+        "elapsed": elapsed,
+    }
+
+
+if __name__ == "__main__":
+    import json
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
+    for mode in ("async", "sync"):
+        print(json.dumps(run_demo(mode=mode), default=str))

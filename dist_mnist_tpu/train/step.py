@@ -28,6 +28,72 @@ from dist_mnist_tpu.train.state import TrainState
 LossFn = Callable[..., jax.Array]
 
 
+def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
+                dropout_key, *, with_grad_norm: bool = False):
+    """The shared fwd+bwd+update body every step variant compiles."""
+    x = batch["image"].astype(jnp.float32) / 255.0
+    y = batch["label"]
+
+    def loss_of(params):
+        logits, new_model_state = model.apply(
+            params, state.model_state, x, train=True, rng=dropout_key
+        )
+        return loss_fn(logits, y), (logits, new_model_state)
+
+    (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+        loss_of, has_aux=True
+    )(state.params)
+    updates, new_opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+    new_state = TrainState(
+        step=state.step + 1,
+        params=apply_updates(state.params, updates),
+        model_state=new_model_state,
+        opt_state=new_opt_state,
+        rng=state.rng,
+    )
+    out = {
+        "loss": loss.astype(jnp.float32),
+        "accuracy": metrics.accuracy(logits, y),
+    }
+    if with_grad_norm:
+        out["grad_norm"] = global_norm(grads)
+    return new_state, out
+
+
+def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size):
+    """One step with batch sampling inside the program (fused-input body)."""
+
+    def one_step(state: TrainState):
+        sample_key, dropout_key = jax.random.split(
+            jax.random.fold_in(state.rng, state.step)
+        )
+        batch = device_dataset.sample(sample_key, batch_size)
+        return _train_core(model, optimizer, loss_fn, state, batch,
+                           dropout_key)
+
+    return one_step
+
+
+def _lazy_jit(step, mesh, rules, donate, n_args=1):
+    """jit on first call, deriving state shardings from the live state."""
+    compiled: dict = {}
+
+    def wrapper(state, *rest):
+        if "fn" not in compiled:
+            shd = tree_sharding(state, mesh, rules)
+            batch_shd = {"image": batch_sharding(mesh),
+                         "label": batch_sharding(mesh)}
+            in_shd = (shd,) + ((batch_shd,) if n_args == 2 else ())
+            compiled["fn"] = jax.jit(
+                step, in_shardings=in_shd, out_shardings=(shd, None),
+                donate_argnums=(0,) if donate else (),
+            )
+        return compiled["fn"](state, *rest)
+
+    return wrapper
+
+
 def make_train_step(
     model,
     optimizer: Optimizer,
@@ -48,59 +114,11 @@ def make_train_step(
     """
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
-        step_key = jax.random.fold_in(state.rng, state.step)
-        x = batch["image"].astype(jnp.float32) / 255.0
-        y = batch["label"]
+        dropout_key = jax.random.fold_in(state.rng, state.step)
+        return _train_core(model, optimizer, loss_fn, state, batch,
+                           dropout_key, with_grad_norm=with_grad_norm)
 
-        def loss_of(params):
-            logits, new_model_state = model.apply(
-                params, state.model_state, x, train=True, rng=step_key
-            )
-            loss = loss_fn(logits, y)
-            return loss, (logits, new_model_state)
-
-        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
-            loss_of, has_aux=True
-        )(state.params)
-        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
-        new_state = TrainState(
-            step=state.step + 1,
-            params=apply_updates(state.params, updates),
-            model_state=new_model_state,
-            opt_state=new_opt_state,
-            rng=state.rng,
-        )
-        out = {
-            "loss": loss.astype(jnp.float32),
-            "accuracy": metrics.accuracy(logits, y),
-        }
-        if with_grad_norm:
-            out["grad_norm"] = global_norm(grads)
-        return new_state, out
-
-    state_shardings = lambda state: tree_sharding(state, mesh, rules)
-    batch_shd = {"image": batch_sharding(mesh), "label": batch_sharding(mesh)}
-
-    def jitted(state_example: TrainState):
-        """Compile with shardings derived from a concrete/abstract state."""
-        return jax.jit(
-            step,
-            in_shardings=(state_shardings(state_example), batch_shd),
-            out_shardings=(state_shardings(state_example), None),
-            donate_argnums=(0,) if donate else (),
-        )
-
-    # Most callers just want the step; compile lazily on first call with the
-    # actual state so sharding pytrees always match.
-    compiled_cache: dict = {}
-
-    def step_fn(state: TrainState, batch):
-        if "fn" not in compiled_cache:
-            compiled_cache["fn"] = jitted(state)
-        return compiled_cache["fn"](state, batch)
-
-    step_fn.lower = lambda state, batch: jitted(state).lower(state, batch)
-    return step_fn
+    return _lazy_jit(step, mesh, rules, donate, n_args=2)
 
 
 def make_fused_train_step(
@@ -119,49 +137,9 @@ def make_fused_train_step(
     entire per-step wire traffic is gone, not just moved). This is the
     bench-path step; semantics = with-replacement sampling (vs the hooked
     loop's shuffled epochs)."""
-
-    def step(state: TrainState):
-        sample_key, dropout_key = jax.random.split(
-            jax.random.fold_in(state.rng, state.step)
-        )
-        batch = device_dataset.sample(sample_key, batch_size)
-        x = batch["image"].astype(jnp.float32) / 255.0
-        y = batch["label"]
-
-        def loss_of(params):
-            logits, new_ms = model.apply(
-                params, state.model_state, x, train=True, rng=dropout_key
-            )
-            return loss_fn(logits, y), (logits, new_ms)
-
-        (loss, (logits, new_ms)), grads = jax.value_and_grad(
-            loss_of, has_aux=True
-        )(state.params)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        new_state = TrainState(
-            step=state.step + 1,
-            params=apply_updates(state.params, updates),
-            model_state=new_ms,
-            opt_state=new_opt,
-            rng=state.rng,
-        )
-        return new_state, {
-            "loss": loss.astype(jnp.float32),
-            "accuracy": metrics.accuracy(logits, y),
-        }
-
-    compiled: dict = {}
-
-    def step_fn(state: TrainState):
-        if "fn" not in compiled:
-            shd = tree_sharding(state, mesh, rules)
-            compiled["fn"] = jax.jit(
-                step, in_shardings=(shd,), out_shardings=(shd, None),
-                donate_argnums=(0,),
-            )
-        return compiled["fn"](state)
-
-    return step_fn
+    one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
+                               batch_size)
+    return _lazy_jit(one_step, mesh, rules, donate=True)
 
 
 def make_scanned_train_fn(
@@ -182,52 +160,16 @@ def make_scanned_train_fn(
     are the mean over the chunk. Small models are dispatch-bound in the
     per-step loop; this removes that ceiling."""
 
-    def one_step(state: TrainState, _):
-        sample_key, dropout_key = jax.random.split(
-            jax.random.fold_in(state.rng, state.step)
-        )
-        batch = device_dataset.sample(sample_key, batch_size)
-        x = batch["image"].astype(jnp.float32) / 255.0
-        y = batch["label"]
-
-        def loss_of(params):
-            logits, new_ms = model.apply(
-                params, state.model_state, x, train=True, rng=dropout_key
-            )
-            return loss_fn(logits, y), (logits, new_ms)
-
-        (loss, (logits, new_ms)), grads = jax.value_and_grad(
-            loss_of, has_aux=True
-        )(state.params)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        new_state = TrainState(
-            step=state.step + 1,
-            params=apply_updates(state.params, updates),
-            model_state=new_ms,
-            opt_state=new_opt,
-            rng=state.rng,
-        )
-        return new_state, {
-            "loss": loss.astype(jnp.float32),
-            "accuracy": metrics.accuracy(logits, y),
-        }
+    one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
+                               batch_size)
 
     def run_chunk(state: TrainState):
-        state, outs = jax.lax.scan(one_step, state, None, length=chunk)
+        state, outs = jax.lax.scan(
+            lambda s, _: one_step(s), state, None, length=chunk
+        )
         return state, jax.tree.map(jnp.mean, outs)
 
-    compiled: dict = {}
-
-    def run(state: TrainState):
-        if "fn" not in compiled:
-            shd = tree_sharding(state, mesh, rules)
-            compiled["fn"] = jax.jit(
-                run_chunk, in_shardings=(shd,), out_shardings=(shd, None),
-                donate_argnums=(0,),
-            )
-        return compiled["fn"](state)
-
-    return run
+    return _lazy_jit(run_chunk, mesh, rules, donate=True)
 
 
 def make_eval_step(model, mesh: Mesh):

@@ -1,0 +1,56 @@
+"""Shared build-and-load machinery for the C++ components.
+
+One place for the g++ invocation, mtime-based rebuild cache, and lazy CDLL
+loading used by parallel/ps_demo and data/native.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_loaded: dict[Path, ctypes.CDLL] = {}
+
+
+def build_shared_lib(src: Path, out: Path, *, force: bool = False) -> Path:
+    """Compile src -> out with g++ (skipped when out is newer than src)."""
+    with _lock:
+        if not force and out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+            return out
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               str(src), "-o", str(out)]
+        log.info("building native library: %s", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise RuntimeError("g++ not available for native components") from e
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build failed:\n{e.stderr}"
+            ) from e
+        return out
+
+
+def load_lib(src: Path, out: Path, signatures: dict) -> ctypes.CDLL:
+    """Build (if needed) + load + apply ctypes signatures; cached per path.
+
+    `signatures`: name -> (argtypes, restype).
+    """
+    with _lock:
+        if out in _loaded:
+            return _loaded[out]
+    build_shared_lib(src, out)
+    lib = ctypes.CDLL(str(out))
+    for name, (argtypes, restype) in signatures.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    with _lock:
+        _loaded[out] = lib
+    return lib

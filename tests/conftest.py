@@ -57,5 +57,5 @@ def small_mnist():
     """Small synthetic MNIST so tests stay fast."""
     from dist_mnist_tpu.data.datasets import load_dataset
 
-    return load_dataset("mnist", "/nonexistent", seed=0,
+    return load_dataset("mnist", "/definitely-not-a-dir", seed=0, cache_synthetic=False,
                         synthetic_sizes=(4096, 512))

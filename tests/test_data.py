@@ -101,3 +101,17 @@ def test_sharded_batcher_rejects_oversized_batch(mesh8, small_mnist):
 
     with pytest.raises(ValueError, match="exceeds dataset size"):
         next(iter(ShardedBatcher(small_mnist, 1 << 20, mesh8)))
+
+
+def test_synthetic_cache_roundtrip(tmp_path):
+    """Full-size synthetic twins cache to disk atomically, reload fast, and
+    KEEP synthetic=True (the marker file); corrupt files fall back."""
+    ds1 = load_dataset("mnist", tmp_path, synthetic_sizes=(60_000, 10_000))
+    assert ds1.synthetic
+    ds2 = load_dataset("mnist", tmp_path)
+    assert ds2.synthetic  # cached twin must not masquerade as real data
+    np.testing.assert_array_equal(ds1.train_images, ds2.train_images)
+    # corrupt a cached file: loader must fall back to synthesis, not crash
+    (tmp_path / "train-images-idx3-ubyte").write_bytes(b"\x00\x00\x08\x03trunc")
+    ds3 = load_dataset("mnist", tmp_path, synthetic_sizes=(512, 128))
+    assert ds3.synthetic and len(ds3.train_labels) == 512

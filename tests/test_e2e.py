@@ -19,7 +19,7 @@ def test_config_registry_covers_ladder():
 
 def test_mlp_mnist_e2e(tmp_path):
     cfg = get_config("mlp_mnist", train_steps=250, eval_every=0)
-    state, final, ctx = run_config(cfg, data_dir="/nonexistent",
+    state, final, ctx = run_config(cfg, data_dir=str(tmp_path / "data"),
                                    logdir=str(tmp_path / "logs"))
     assert final["accuracy"] >= 0.95  # §7 step 5 bar is 0.97 @ 2000 steps
     assert state.step_int == 250
@@ -29,11 +29,12 @@ def test_mlp_mnist_e2e(tmp_path):
 def test_checkpoint_resume_through_driver(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     cfg = get_config("mlp_mnist", train_steps=30, eval_every=0)
-    s1, _, _ = run_config(cfg, data_dir="/nonexistent", checkpoint_dir=ckpt)
+    data = str(tmp_path / "data")
+    s1, _, _ = run_config(cfg, data_dir=data, checkpoint_dir=ckpt)
     assert s1.step_int == 30
     # "restart": same config, more steps — must resume from 30, not 0
     cfg2 = get_config("mlp_mnist", train_steps=60, eval_every=0)
-    s2, _, _ = run_config(cfg2, data_dir="/nonexistent", checkpoint_dir=ckpt)
+    s2, _, _ = run_config(cfg2, data_dir=data, checkpoint_dir=ckpt)
     assert s2.step_int == 60
 
 
@@ -43,5 +44,5 @@ def test_lenet_fashion_dp4(tmp_path):
         "lenet5_fashion", train_steps=120, eval_every=0, batch_size=128,
         mesh=MeshSpec(data=4),
     )
-    _, final, _ = run_config(cfg, data_dir="/nonexistent")
+    _, final, _ = run_config(cfg, data_dir=str(tmp_path / "data"))
     assert final["accuracy"] >= 0.9

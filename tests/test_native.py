@@ -1,0 +1,181 @@
+"""Native C++ components: PS demo (async/sync protocol) + prefetch loader."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("ctypes")
+
+
+# ---------------------------------------------------------------------------
+# parameter server
+
+
+@pytest.fixture(scope="module")
+def ps_lib():
+    from dist_mnist_tpu.parallel.ps_demo.bindings import build_library
+
+    try:
+        build_library()
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"toolchain unavailable: {e}")
+    return True
+
+
+def test_ps_pull_push_adam_matches_reference(ps_lib):
+    """Native ApplyAdam == the framework's Python/XLA Adam (same rule)."""
+    from dist_mnist_tpu.parallel.ps_demo.bindings import ParameterServer
+
+    rng = np.random.default_rng(0)
+    p0 = rng.normal(size=(37,)).astype(np.float32)
+    grads = [rng.normal(size=(37,)).astype(np.float32) for _ in range(4)]
+
+    ps = ParameterServer([37], lr=0.01)
+    ps.init(p0)
+    for i, g in enumerate(grads):
+        assert ps.push_async(g, local_step=i)
+    native, step = ps.pull()
+    assert step == 4
+
+    import jax.numpy as jnp
+
+    from dist_mnist_tpu import optim
+
+    opt = optim.adam(0.01)
+    params = {"w": jnp.asarray(p0)}
+    state = opt.init(params)
+    for g in grads:
+        updates, state = opt.update({"w": jnp.asarray(g)}, state, params)
+        params = optim.apply_updates(params, updates)
+    np.testing.assert_allclose(native, np.asarray(params["w"]), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_ps_async_staleness_drop(ps_lib):
+    from dist_mnist_tpu.parallel.ps_demo.bindings import ParameterServer
+
+    ps = ParameterServer([4], lr=0.0, staleness_bound=1)
+    ps.init(np.zeros(4, np.float32))
+    g = np.ones(4, np.float32)
+    assert ps.push_async(g, 0)  # step 0 -> 1
+    assert ps.push_async(g, 1)  # step 1 -> 2
+    assert not ps.push_async(g, 0)  # 0 + bound(1) < 2 -> dropped
+    assert ps.dropped == 1
+
+
+def test_ps_sync_aggregation_and_tokens(ps_lib):
+    """Accumulator averages exactly N fresh grads; tokens broadcast the new
+    step; stale grads are dropped (conditional_accumulator_base.h:34-46)."""
+    from dist_mnist_tpu.parallel.ps_demo.bindings import ParameterServer
+
+    ps = ParameterServer([2], lr=1.0, b1=0.0, b2=0.0, eps=0.0,
+                         replicas_to_aggregate=2)
+    ps.init(np.zeros(2, np.float32))
+    assert ps.push_sync(np.array([1.0, 3.0], np.float32), 0)
+    assert ps.push_sync(np.array([3.0, 1.0], np.float32), 0)
+    new_step = ps.chief_sync_once(tokens_per_step=2)
+    assert new_step == 1
+    assert ps.dequeue_token() == 1
+    assert ps.dequeue_token() == 1
+    # b1=b2=0, eps=0, lr=1: update = -sqrt(1-0)/1 * g/|g| = -sign... with
+    # m=g, v=g^2: delta = -1 * g/sqrt(g^2) = -sign(g); avg grad = (2,2).
+    params, _ = ps.pull()
+    np.testing.assert_allclose(params, [-1.0, -1.0], rtol=1e-6)
+    # a gradient stamped before the take is now stale
+    assert not ps.push_sync(np.array([1.0, 1.0], np.float32), 0)
+    assert ps.push_sync(np.array([1.0, 1.0], np.float32), 1)
+
+
+def test_ps_demo_end_to_end_both_modes(ps_lib, small_mnist):
+    from dist_mnist_tpu.parallel.ps_demo import run_demo
+
+    sync = run_demo(mode="sync", num_workers=2, train_steps=120,
+                    dataset=small_mnist)
+    assert sync["global_step"] >= 120
+    assert sync["test_accuracy"] > 0.8
+    async_ = run_demo(mode="async", num_workers=2, train_steps=120,
+                      dataset=small_mnist)
+    assert async_["global_step"] >= 120
+    assert async_["test_accuracy"] > 0.6  # staleness costs some accuracy
+    assert sum(async_["per_worker_applies"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# native loader
+
+
+@pytest.fixture(scope="module")
+def loader_lib():
+    from dist_mnist_tpu.data.native.batcher import build_library
+
+    try:
+        build_library()
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"toolchain unavailable: {e}")
+    return True
+
+
+def test_native_loader_deterministic_epochs(loader_lib, mesh8, small_mnist):
+    from dist_mnist_tpu.data.native import NativeBatcher
+
+    a = NativeBatcher(small_mnist, 64, mesh8, seed=7)
+    b = NativeBatcher(small_mnist, 64, mesh8, seed=7)
+    seen = []
+    for _ in range(10):
+        ia, la, sa = a.next_local()
+        ib, lb, sb = b.next_local()
+        assert sa == sb
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(la, lb)
+        seen.append((ia, la))
+    # batches really are gathered rows of the dataset
+    img, lab = seen[0]
+    n = small_mnist.train_images.shape[0]
+    # find the first row in the dataset (exact match must exist)
+    row = img[0]
+    matches = np.where(
+        (small_mnist.train_images.reshape(n, -1) == row.reshape(-1)).all(1)
+    )[0]
+    assert len(matches) >= 1
+    assert small_mnist.train_labels[matches[0]] == lab[0] or len(matches) > 1
+    a.close()
+    b.close()
+
+
+def test_native_loader_epoch_coverage(loader_lib, mesh8, small_mnist):
+    """One epoch = each index used exactly once (shuffled without
+    replacement), matching the Python pipeline's contract."""
+    from dist_mnist_tpu.data.native import NativeBatcher
+
+    n = small_mnist.train_images.shape[0]
+    batch = 512
+    per_epoch = n // batch
+    nb = NativeBatcher(small_mnist, batch, mesh8, seed=3)
+    label_counts = np.zeros(10, np.int64)
+    for _ in range(per_epoch):
+        _, lab, _ = nb.next_local()
+        label_counts += np.bincount(lab, minlength=10)
+    expected = np.bincount(small_mnist.train_labels[: per_epoch * batch],
+                           minlength=10)
+    # same multiset of labels per epoch (indices are a permutation)
+    assert label_counts.sum() == per_epoch * batch
+    full = np.bincount(small_mnist.train_labels, minlength=10)
+    assert (label_counts <= full).all()
+    nb.close()
+
+
+def test_native_loader_rejects_bad_batch(loader_lib, mesh8, small_mnist):
+    from dist_mnist_tpu.data.native import NativeBatcher
+
+    with pytest.raises(ValueError):
+        NativeBatcher(small_mnist, 1 << 20, mesh8)
+
+
+def test_native_loader_yields_sharded_batches(loader_lib, mesh8, small_mnist):
+    from dist_mnist_tpu.data.native import NativeBatcher
+
+    nb = NativeBatcher(small_mnist, 64, mesh8, seed=0)
+    batch = next(iter(nb))
+    assert batch["image"].shape == (64, 28, 28, 1)
+    shard = batch["image"].sharding.shard_shape(batch["image"].shape)
+    assert shard[0] == 8
+    nb.close()
