@@ -196,14 +196,20 @@ def run_config(
             hooks.append(hooks_lib.ProfilerHook(logdir))
         hooks.extend(extra_hooks)
 
+        # resume-aware: start the stream at the restored step so the
+        # post-restore trajectory equals the uninterrupted one (the
+        # reference replayed the epoch from scratch — next_batch state died
+        # with the process, SURVEY.md §3.5)
         if input_pipeline == "native":
             from dist_mnist_tpu.data.native import NativeBatcher
 
             batches = NativeBatcher(dataset, cfg.batch_size, mesh,
-                                    seed=cfg.seed)
+                                    seed=cfg.seed,
+                                    start_step=state.step_int)
         else:
             batches = ShardedBatcher(dataset, cfg.batch_size, mesh,
-                                     seed=cfg.seed)
+                                     seed=cfg.seed,
+                                     start_step=state.step_int)
         loop = TrainLoop(
             step_fn,
             state,

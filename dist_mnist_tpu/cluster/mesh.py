@@ -9,6 +9,8 @@ Axes (any may be size 1 and is then squeezed out of collectives by XLA):
 - ``data``  — data parallelism; gradients are all-reduced over it.
 - ``model`` — tensor parallelism; weight matrices are sharded over it.
 - ``seq``   — sequence/context parallelism (ring attention, all-to-all).
+- ``pipe``  — pipeline parallelism; layer stages are sharded over it
+  (GPipe microbatch schedule, parallel/pipeline.py).
 """
 
 from __future__ import annotations
@@ -24,7 +26,8 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
-AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+PIPE_AXIS = "pipe"
+AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,21 +37,24 @@ class MeshSpec:
     data: int = -1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int]:
-        fixed = self.model * self.seq
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        fixed = self.model * self.seq * self.pipe
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by model*seq={fixed}"
+                    f"{n_devices} devices not divisible by "
+                    f"model*seq*pipe={fixed}"
                 )
             data = n_devices // fixed
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.model}x{self.seq} != {n_devices} devices"
+                f"mesh {data}x{self.model}x{self.seq}x{self.pipe} != "
+                f"{n_devices} devices"
             )
-        return (data, self.model, self.seq)
+        return (data, self.model, self.seq, self.pipe)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,14 +101,14 @@ def make_mesh(
         # fully-specified mesh may use a subset of visible devices (e.g. the
         # 4-way config on an 8-device host — ≙ a worker_hosts list shorter
         # than the machine pool)
-        want = spec.data * spec.model * spec.seq
+        want = spec.data * spec.model * spec.seq * spec.pipe
         if want > len(devices):
             raise ValueError(
                 f"mesh needs {want} devices, only {len(devices)} visible"
             )
         devices = devices[:want]
     shape = spec.resolve(len(devices))
-    # Squeeze trailing singleton axes out of the mesh? No — keep all three
+    # Squeeze trailing singleton axes out of the mesh? No — keep all four
     # axes so PartitionSpecs are uniform across configs; XLA elides
     # collectives over size-1 axes.
     try:

@@ -26,7 +26,7 @@ def _get_lib():
     i64 = ctypes.c_int64
     return load_lib(_SRC, _LIB, {
         "loader_create": ([u8p, i32p, i64, i64, i64, ctypes.c_uint64,
-                           ctypes.c_int, i64, i64], ctypes.c_void_p),
+                           ctypes.c_int, i64, i64, i64], ctypes.c_void_p),
         "loader_next": ([ctypes.c_void_p, u8p, i32p], i64),
         "loader_close": ([ctypes.c_void_p], None),
         "loader_destroy": ([ctypes.c_void_p], None),
@@ -43,8 +43,11 @@ class NativeBatcher:
     """
 
     def __init__(self, dataset, global_batch: int, mesh, *, seed: int = 0,
-                 prefetch_depth: int = 4):
+                 prefetch_depth: int = 4, start_step: int = 0):
         import jax
+
+        self._ctor_args = (dataset, global_batch, mesh)
+        self._ctor_kwargs = dict(seed=seed, prefetch_depth=prefetch_depth)
 
         n = dataset.train_images.shape[0]
         if global_batch > n:
@@ -65,7 +68,7 @@ class NativeBatcher:
             self._images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             n, self._row_bytes, global_batch, seed, prefetch_depth,
-            pid * self.local, self.local,
+            pid * self.local, self.local, start_step,
         )
         if not self._h:
             raise RuntimeError("loader_create failed (bad batch/depth)")
@@ -92,6 +95,13 @@ class NativeBatcher:
             except StopIteration:
                 return
             yield shard_batch({"image": img, "label": lab}, self.mesh)
+
+    def at_step(self, step: int) -> "NativeBatcher":
+        """A fresh batcher positioned at `step` — non-destructive, matching
+        ShardedBatcher.at_step (this instance keeps streaming; its producer
+        thread is reclaimed on GC)."""
+        return NativeBatcher(*self._ctor_args, **self._ctor_kwargs,
+                             start_step=step)
 
     def close(self):
         if getattr(self, "_h", None):

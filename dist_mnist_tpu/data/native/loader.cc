@@ -43,7 +43,7 @@ class Loader {
  public:
   Loader(const uint8_t* images, const int32_t* labels, int64_t n,
          int64_t row_bytes, int64_t batch, uint64_t seed, int depth,
-         int64_t slice_begin, int64_t slice_size)
+         int64_t slice_begin, int64_t slice_size, int64_t start_step)
       : images_(images),
         labels_(labels),
         n_(n),
@@ -53,6 +53,7 @@ class Loader {
         depth_(depth),
         slice_begin_(slice_begin),
         slice_size_(slice_size > 0 ? slice_size : batch),
+        start_step_(start_step),
         slots_(depth) {
     for (auto& s : slots_) {
       s.img.resize((size_t)(slice_size_)*row_bytes_);
@@ -79,7 +80,7 @@ class Loader {
     Slot& s = slots_[head_ % depth_];
     std::memcpy(img_out, s.img.data(), s.img.size());
     std::memcpy(lab_out, s.lab.data(), s.lab.size() * sizeof(int32_t));
-    const int64_t step = head_++;
+    const int64_t step = start_step_ + head_++;
     cv_.notify_all();
     return step;
   }
@@ -98,12 +99,16 @@ class Loader {
 
   void produce() {
     std::vector<int64_t> perm((size_t)n_);
-    uint64_t epoch = 0;
     const int64_t per_epoch = n_ / batch_;
+    // resume-aware: position is a pure function of step, so a restored
+    // trainer passes start_step and the stream continues exactly where the
+    // pre-preemption run left off (mirrors pipeline.ShardedBatcher).
+    uint64_t epoch = (uint64_t)(start_step_ / per_epoch);
     shuffle_epoch(perm, seed_, epoch);
-    for (int64_t step = 0;; ++step) {
+    for (int64_t step = start_step_;; ++step) {
       const int64_t in_epoch = step % per_epoch;
-      if (step > 0 && in_epoch == 0) shuffle_epoch(perm, seed_, ++epoch);
+      if (step > start_step_ && in_epoch == 0)
+        shuffle_epoch(perm, seed_, ++epoch);
       {
         std::unique_lock<std::mutex> lk(mu_);
         cv_.wait(lk, [&] { return stop_ || tail_ - head_ < depth_; });
@@ -130,7 +135,7 @@ class Loader {
   const int64_t n_, row_bytes_, batch_;
   const uint64_t seed_;
   const int depth_;
-  const int64_t slice_begin_, slice_size_;
+  const int64_t slice_begin_, slice_size_, start_step_;
   std::vector<Slot> slots_;
   std::mutex mu_;
   std::condition_variable cv_;
@@ -145,10 +150,11 @@ extern "C" {
 
 void* loader_create(const uint8_t* images, const int32_t* labels, int64_t n,
                     int64_t row_bytes, int64_t batch, uint64_t seed,
-                    int depth, int64_t slice_begin, int64_t slice_size) {
-  if (batch > n || batch <= 0 || depth <= 0) return nullptr;
+                    int depth, int64_t slice_begin, int64_t slice_size,
+                    int64_t start_step) {
+  if (batch > n || batch <= 0 || depth <= 0 || start_step < 0) return nullptr;
   return new Loader(images, labels, n, row_bytes, batch, seed, depth,
-                    slice_begin, slice_size);
+                    slice_begin, slice_size, start_step);
 }
 int64_t loader_next(void* l, uint8_t* img, int32_t* lab) {
   return static_cast<Loader*>(l)->next(img, lab);

@@ -74,6 +74,11 @@ class ShardedBatcher:
     global_batch: int
     mesh: Mesh
     seed: int = 0
+    start_step: int = 0
+
+    def at_step(self, step: int) -> "ShardedBatcher":
+        """A batcher positioned at `step` (TrainLoop recovery re-seek)."""
+        return dataclasses.replace(self, start_step=step)
 
     def __iter__(self) -> Iterator[dict[str, jax.Array]]:
         n = self.dataset.train_images.shape[0]
@@ -86,11 +91,19 @@ class ShardedBatcher:
                 "an epoch yields zero batches"
             )
         local = self.global_batch // n_proc
-        epoch = 0
+        # resume exactly where a restored step left off — the reference
+        # could not (next_batch position lived in process memory and died
+        # with it; SURVEY.md §3.5 restores variables only). Position is a
+        # pure function of step, so restart = seek.
+        steps_per_epoch = n // self.global_batch
+        epoch = self.start_step // steps_per_epoch
+        skip = self.start_step % steps_per_epoch
         while True:
-            for idx in epoch_batches(
+            for b, idx in enumerate(epoch_batches(
                 n, self.global_batch, seed=self.seed, epoch=epoch
-            ):
+            )):
+                if b < skip:
+                    continue
                 mine = idx[pid * local : (pid + 1) * local]
                 yield shard_batch(
                     {
@@ -99,6 +112,7 @@ class ShardedBatcher:
                     },
                     self.mesh,
                 )
+            skip = 0
             epoch += 1
 
 

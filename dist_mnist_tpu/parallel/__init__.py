@@ -12,6 +12,9 @@ collective over ICI compiled into the step program.
   helpers for the explicit-SPMD path.
 - `ring_attention.py` — sequence-parallel ring attention (ppermute K/V).
 - `ulysses.py` — all-to-all head<->sequence reshard alternative.
+- `pipeline.py` — GPipe pipeline parallelism over the `pipe` axis.
+- `moe.py` — expert-parallel switch MoE (all_to_all dispatch).
+- `ps_demo/` — native C++ demo of the reference's async-PS protocol.
 """
 
 from dist_mnist_tpu.parallel.sharding import (

@@ -1,0 +1,165 @@
+"""Expert parallelism — switch-style MoE FFN with `all_to_all` dispatch.
+
+Absent from the reference (SURVEY.md §2.6 lists EP as out of scope for
+parity) but first-class here, because on TPU the dispatch primitive the
+whole technique hangs on — `lax.all_to_all` over an ICI axis — is a single
+compiled collective rather than the NCCL grouped send/recv a CUDA
+implementation hand-rolls.
+
+Design (top-1 "switch" routing, one expert per rank of the expert axis):
+- gate: tokens [T, D] -> scores [T, E]; each token routes to argmax expert
+  with its softmax prob as combine weight.
+- capacity: C = ceil(T/E * capacity_factor); tokens beyond an expert's
+  capacity are dropped (contribute zero — the standard switch behavior).
+- dispatch: one-hot [T, E, C] mask -> [E, C, D] buffer -> tiled
+  `all_to_all` so each rank receives the tokens bound for ITS expert from
+  every rank -> expert FFN (dense relu dense) -> reverse `all_to_all` ->
+  weighted combine back to [T, D].
+- aux: load-balance loss (Shazeer/Switch form): E * sum_e f_e * p_e, where
+  f_e = fraction of tokens routed to e, p_e = mean router prob for e.
+
+`jax.grad` differentiates through both all_to_alls (they transpose to each
+other), so expert-parallel backward needs no extra code.
+
+Entry points:
+- `init_moe(key, dim, hidden, n_experts)` — param pytree; expert weights
+  have leading dim E for sharding over the expert axis.
+- `moe_ffn_inner(params, x, axis_name)` — inside shard_map (params' expert
+  leaves pre-sliced to this rank's experts).
+- `moe_ffn(params, x, mesh, axis_name=MODEL_AXIS)` — jit-able wrapper;
+  one expert per rank (E == axis size).
+- `moe_ffn_dense(params, x)` — no-mesh reference implementation (all
+  experts local); the numeric oracle for tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
+from dist_mnist_tpu.ops.nn import fan_in_trunc_normal
+
+
+def init_moe(key, dim: int, hidden: int, n_experts: int):
+    """Gate [D, E] + per-expert FFN stacks [E, ...]."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    return {
+        "gate": fan_in_trunc_normal(kg, (dim, n_experts)),
+        "w1": fan_in_trunc_normal(k1, (n_experts, dim, hidden)),
+        "b1": jnp.zeros((n_experts, hidden)),
+        "w2": fan_in_trunc_normal(k2, (n_experts, hidden, dim)),
+        "b2": jnp.zeros((n_experts, dim)),
+    }
+
+
+def _route(gate_w, x, n_experts: int, capacity: int):
+    """Top-1 routing tensors: combine [T,E,C] (prob on the chosen slot),
+    dispatch = combine != 0, plus the router statistics (f, p) the aux
+    load-balance loss is built from. f/p are LOCAL means over the tokens
+    seen here; the caller reduces them to global means before forming
+    aux = E * Σ_e f_e p_e (the Switch form) — aux is linear in neither, so
+    the reduction must happen on f/p, not on per-shard aux values."""
+    scores = x @ gate_w  # [T, E]
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+    gate_val = jnp.max(probs, axis=-1)  # [T]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+    # position of each token in its expert's queue (exclusive cumsum)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [T, E], int-valued
+    in_cap = (pos < capacity).astype(jnp.float32) * onehot
+    pos_idx = jnp.sum(pos, axis=-1).astype(jnp.int32)  # [T]
+    slot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)  # [T, C]
+    dispatch = jnp.einsum("te,tc->tec", in_cap, slot)  # [T, E, C] 0/1
+    combine = dispatch * gate_val[:, None, None]
+    f = jnp.mean(onehot, axis=0)  # [E] fraction of tokens per expert
+    p = jnp.mean(probs, axis=0)  # [E] mean router prob per expert
+    return dispatch, combine, f, p
+
+
+def _expert_ffn(w1, b1, w2, b2, tokens):
+    h = jax.nn.relu(tokens @ w1 + b1)
+    return h @ w2 + b2
+
+
+def moe_ffn_dense(params, x, capacity_factor: float = 1.25):
+    """All experts local — the einsum-only oracle (also the fallback on a
+    mesh without an expert axis)."""
+    t, _ = x.shape
+    e = params["gate"].shape[-1]
+    capacity = max(1, int(-(-t // e) * capacity_factor))
+    dispatch, combine, f, p = _route(params["gate"], x, e, capacity)
+    aux = e * jnp.sum(f * p)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    expert_out = jax.vmap(_expert_ffn)(
+        params["w1"], params["b1"], params["w2"], params["b2"], expert_in
+    )
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.astype(x.dtype), aux
+
+
+def moe_ffn_inner(params, x, axis_name: str = MODEL_AXIS,
+                  capacity_factor: float = 1.25, aux_axes=None):
+    """Inside shard_map: x [T_local, D] — tokens sharded over the expert
+    axis too (canonical EP: the expert axis doubles as extra data sharding
+    outside the MoE layer); params' expert leaves sliced to this rank
+    (leading dim 1 — one expert per rank). `aux_axes`: every mesh axis the
+    tokens are sharded over (default: just `axis_name`); router statistics
+    are pmean'd over them so aux equals the dense oracle's global value."""
+    n_experts = lax.axis_size(axis_name)
+    t, _ = x.shape
+    capacity = max(1, int(-(-t // n_experts) * capacity_factor))
+    dispatch, combine, f, p = _route(params["gate"], x, n_experts, capacity)
+    aux_axes = (axis_name,) if aux_axes is None else tuple(aux_axes)
+    f, p = lax.pmean(f, aux_axes), lax.pmean(p, aux_axes)
+    aux = n_experts * jnp.sum(f * p)
+    # [T,E,C] x [T,D] -> [E, C, D] send buffer (row e = tokens for expert e)
+    send = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # THE dispatch collective: rank r ends up with the C tokens every rank
+    # routed to ITS expert, concatenated in rank order -> [1, E*C, D]
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+    w1, b1, w2, b2 = (jnp.squeeze(params[k], 0) for k in
+                      ("w1", "b1", "w2", "b2"))
+    out_tok = _expert_ffn(w1, b1, w2, b2, recv[0])  # [E*C, D]
+    # reverse all_to_all: chunk s of out_tok goes back to rank s; what
+    # arrives from rank e is expert e's outputs for OUR tokens -> [E, C, D]
+    expert_out = lax.all_to_all(
+        out_tok.reshape(n_experts, capacity, -1), axis_name,
+        split_axis=0, concat_axis=0, tiled=True,
+    )
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.astype(x.dtype), aux
+
+
+def moe_ffn(params, x, mesh: Mesh, axis_name: str = MODEL_AXIS,
+            capacity_factor: float = 1.25):
+    """Expert-parallel switch FFN over `mesh`'s `axis_name`; one expert per
+    rank (E == axis size). x: [T, D] tokens, sharded jointly over
+    `data` x the expert axis (T % (data*E) == 0); gate replicated; expert
+    stacks sharded on their leading dim."""
+    e = mesh.shape[axis_name]
+    if params["gate"].shape[-1] != e:
+        raise ValueError(
+            f"n_experts {params['gate'].shape[-1]} != {axis_name} axis {e}"
+        )
+    p_spec = {
+        "gate": P(),
+        "w1": P(axis_name), "b1": P(axis_name),
+        "w2": P(axis_name), "b2": P(axis_name),
+    }
+    tok_spec = P((DATA_AXIS, axis_name))
+    run = jax.shard_map(
+        partial(moe_ffn_inner, axis_name=axis_name,
+                capacity_factor=capacity_factor,
+                aux_axes=(DATA_AXIS, axis_name)),
+        mesh=mesh,
+        in_specs=(p_spec, tok_spec),
+        out_specs=(tok_spec, P()),
+        check_vma=False,
+    )
+    return run(params, x)

@@ -1,0 +1,130 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the `pipe` axis.
+
+Absent from the reference (SURVEY.md §2.6 lists PP as "not needed for
+parity"), but first-class here: a stack of identically-shaped layer stages
+is sharded over the `pipe` mesh axis (one stage per pipe rank), a global
+batch is split into M microbatches, and activations flow stage→stage around
+the ICI ring via `ppermute`. The schedule is the classic GPipe ladder: at
+tick t, stage s computes microbatch t-s; the pipe drains after
+M + S - 1 ticks. Bubble fraction = (S-1)/(M+S-1) — pick M >= 4*S to keep
+the MXU busy.
+
+Everything is inside one SPMD program, so `jax.grad` differentiates through
+the schedule (`ppermute` transposes to the reverse rotation), giving the
+1F1B-equivalent backward sweep for free — no hand-written send/recv of
+gradients, which is what a CUDA/NCCL pipeline implementation spends most of
+its code on.
+
+Composability: the batch dimension stays sharded over `data` (each pipe
+group runs the same schedule on its slice of the batch), so PP x DP works
+out of one spec. Requires all stages to share one activation shape — true
+for the repeated encoder blocks this targets (ViT depth, MLP towers).
+
+Entry points:
+- `pipeline_apply_inner(fn, stage_params, x_mb, axis_name)` — inside
+  shard_map; x_mb is [M, mb, ...] microbatched activations.
+- `pipeline_apply(fn, stacked_params, x, num_microbatches, mesh)` — jits a
+  shard_map over `mesh`'s pipe (and data) axes.
+- `stack_stage_params(params_list)` — stack S per-stage pytrees along a new
+  leading axis for sharding over `pipe`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import DATA_AXIS, PIPE_AXIS
+from dist_mnist_tpu.parallel.collectives import ring_shift
+
+
+def stack_stage_params(params_list):
+    """Stack S per-stage param pytrees into one pytree with leading dim S
+    (the dim sharded over `pipe`). All stages must be isomorphic."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *params_list)
+
+
+def pipeline_apply_inner(fn, stage_params, x_mb, axis_name: str = PIPE_AXIS):
+    """Run the GPipe schedule; call inside shard_map.
+
+    fn: (params, x) -> y with y.shape == x.shape (one stage).
+    stage_params: THIS stage's params, leading stage axis of size 1
+      (as delivered by shard_map with spec P(pipe)); squeezed here.
+    x_mb: [M, mb, ...] microbatches (replicated over `pipe`).
+    Returns [M, mb, ...] outputs (identical on every pipe rank).
+    """
+    params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    s = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    n_mb = x_mb.shape[0]
+    first = jnp.equal(s, 0)
+    last = jnp.equal(s, n_stages - 1)
+
+    def tick(t, carry):
+        act, out_buf = carry
+        # stage 0 ingests microbatch t (clip keeps the index static-safe
+        # during the drain ticks; the value is masked by `first` anyway)
+        inp = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, n_mb - 1), axis=0, keepdims=False
+        )
+        act = jnp.where(first, inp, act)
+        y = fn(params, act)
+        # last stage retires microbatch t-(S-1); writes during fill ticks
+        # (t < S-1) land on index 0 masked off by `ready`
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_mb - 1)
+        ready = jnp.logical_and(last, t >= n_stages - 1)
+        slot = lax.dynamic_index_in_dim(out_buf, out_idx, axis=0,
+                                        keepdims=False)
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(ready, y, slot), out_idx, axis=0
+        )
+        # rotate activations one stage forward (neighbour ICI hop); XLA
+        # overlaps the ppermute with the next tick's compute
+        act = ring_shift(y, axis_name)
+        return act, out_buf
+
+    act0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+    _, out_buf = lax.fori_loop(0, n_mb + n_stages - 1, tick, (act0, out0),
+                               unroll=False)
+    # only the last stage holds real outputs; broadcast to every rank so the
+    # result is replicated over `pipe` (one S_local-sized all-reduce)
+    return lax.psum(jnp.where(last, out_buf, 0.0), axis_name)
+
+
+def pipeline_apply(fn, stacked_params, x, num_microbatches: int,
+                   mesh: Mesh, axis_name: str = PIPE_AXIS):
+    """GPipe over `mesh`'s pipe axis, batch sharded over `data`.
+
+    stacked_params: leaves [S, ...] (see stack_stage_params), S = pipe size.
+    x: [B, ...] global-batch activations; B % num_microbatches == 0.
+    Returns [B, ...].
+    """
+    n_stages = mesh.shape[axis_name]
+    chex_msg = (
+        f"stacked_params leading dim must equal pipe axis size {n_stages}"
+    )
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(chex_msg + f", got {leaf.shape[0]}")
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} % microbatches {num_microbatches} != 0")
+    x_mb = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+    p_spec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    # microbatch dim unsharded, per-microbatch batch dim over `data`
+    x_spec = P(None, DATA_AXIS)
+    run = jax.shard_map(
+        partial(pipeline_apply_inner, fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(p_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    out = run(stacked_params, x_mb)
+    return out.reshape((b,) + out.shape[2:])

@@ -138,6 +138,13 @@ class TrainLoop:
                         raise
                     self.state = restored
                     self._host_step = self.state.step_int
+                    # re-seek the input stream to the restored step so the
+                    # recovered trajectory equals the uninterrupted one
+                    # (batches consumed between checkpoint and failure must
+                    # be replayed, not skipped)
+                    if hasattr(self.batches, "at_step"):
+                        self.batches = self.batches.at_step(self._host_step)
+                        it = iter(self.batches)
         finally:
             for h in self.hooks:
                 try:

@@ -115,3 +115,40 @@ def test_synthetic_cache_roundtrip(tmp_path):
     (tmp_path / "train-images-idx3-ubyte").write_bytes(b"\x00\x00\x08\x03trunc")
     ds3 = load_dataset("mnist", tmp_path, synthetic_sizes=(512, 128))
     assert ds3.synthetic and len(ds3.train_labels) == 512
+
+
+def test_sharded_batcher_start_step_seeks(mesh8, small_mnist):
+    """A batcher started at step K yields exactly the stream the fresh
+    batcher yields after K batches — across epoch boundaries too."""
+    b = ShardedBatcher(small_mnist, 512, mesh8, seed=7)  # 8 steps/epoch
+    k = 10  # crosses into epoch 1
+    fresh = iter(b)
+    for _ in range(k):
+        next(fresh)
+    seeked = iter(b.at_step(k))
+    for _ in range(3):
+        want, got = next(fresh), next(seeked)
+        np.testing.assert_array_equal(
+            np.asarray(want["image"]), np.asarray(got["image"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(want["label"]), np.asarray(got["label"])
+        )
+
+
+def test_native_batcher_start_step_seeks(mesh8, small_mnist):
+    from dist_mnist_tpu.data.native import NativeBatcher
+
+    b = NativeBatcher(small_mnist, 512, mesh8, seed=7)
+    k = 10
+    imgs = []
+    for _ in range(k + 2):
+        img, lab, step = b.next_local()
+        imgs.append((img, lab, step))
+    b2 = b.at_step(k)
+    for i in range(2):
+        img, lab, step = b2.next_local()
+        assert step == k + i == imgs[k + i][2]
+        np.testing.assert_array_equal(img, imgs[k + i][0])
+        np.testing.assert_array_equal(lab, imgs[k + i][1])
+    b2.close()

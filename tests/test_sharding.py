@@ -16,9 +16,10 @@ from dist_mnist_tpu.parallel.sharding import (
 
 
 def test_mesh_spec_resolution():
-    assert MeshSpec(data=-1).resolve(8) == (8, 1, 1)
-    assert MeshSpec(data=-1, model=2).resolve(8) == (4, 2, 1)
-    assert MeshSpec(data=2, model=2, seq=2).resolve(8) == (2, 2, 2)
+    assert MeshSpec(data=-1).resolve(8) == (8, 1, 1, 1)
+    assert MeshSpec(data=-1, model=2).resolve(8) == (4, 2, 1, 1)
+    assert MeshSpec(data=2, model=2, seq=2).resolve(8) == (2, 2, 2, 1)
+    assert MeshSpec(data=-1, pipe=4).resolve(8) == (2, 1, 1, 4)
     with pytest.raises(ValueError):
         MeshSpec(data=3).resolve(8)
     with pytest.raises(ValueError):
@@ -27,7 +28,7 @@ def test_mesh_spec_resolution():
 
 def test_make_mesh_axes():
     mesh = make_mesh(MeshSpec(data=4, model=2))
-    assert mesh.shape == {"data": 4, "model": 2, "seq": 1}
+    assert mesh.shape == {"data": 4, "model": 2, "seq": 1, "pipe": 1}
     assert len(set(d.id for d in mesh.devices.flat)) == 8
 
 
