@@ -1,0 +1,179 @@
+"""Local multi-process cluster launcher.
+
+The reference was launched as K separate shell invocations of
+`dist_mnist.py --job_name={ps,worker} --task_index=i` against hand-written
+--ps_hosts/--worker_hosts lists (SURVEY.md §0.1; the repo's README/launch
+helpers). This is the one-command replacement: it spawns N identical SPMD
+processes of `cli.train`, wires them to one coordination service
+(`jax.distributed`, the TSL descendant of the reference's GrpcServer —
+grpc_server_lib.h:78-239), streams their interleaved logs with a `[pK]`
+prefix, and propagates the first failure by tearing the rest down — the
+job-level behavior the reference delegated to "run these commands in K
+terminals".
+
+There is no ps/worker asymmetry to configure: every process runs the same
+program, and process 0 is chief by convention (cluster/coordination.py).
+
+`--platform=cpu --devices_per_process=M` simulates an N-host, N*M-device
+cluster on one machine with no accelerator (gloo collectives) — the
+process-level analogue of the reference's `create_local_cluster` test
+fixture (test_util.py:4029-4115), with real process isolation instead of
+in-process servers.
+
+Usage:
+    python -m dist_mnist_tpu.cli.launch --num_processes=2 -- \
+        --config=lenet5_mnist --train_steps=500
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+from absl import app, flags
+
+# cli.train owns the shared flag namespace (--num_processes, --platform, …);
+# importing it first makes flag definitions order-independent for every
+# import order the package sees (its module top is cheap — stdlib + absl)
+import dist_mnist_tpu.cli.train  # noqa: F401
+
+FLAGS = flags.FLAGS
+
+flags.DEFINE_integer("port", 0, "coordinator port (0 = pick a free one)")
+flags.DEFINE_integer("devices_per_process", 1,
+                     "virtual devices per process (cpu platform only)")
+
+
+def _free_port() -> tuple[int, socket.socket]:
+    """Pick a free port and KEEP the probe socket open: the caller holds it
+    until the children are spawned, so two concurrent launch() calls can't
+    be handed the same port (each holds its own while picking). The child
+    coordinator binds seconds later (after jax import) — a closed-and-
+    released port would be a wide race window."""
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    return s.getsockname()[1], s
+
+
+def _pump(proc: subprocess.Popen, tag: str) -> None:
+    """Prefix-and-forward one child's output (ps/worker logs used to live in
+    K different terminals; here they interleave on one stream)."""
+    for line in proc.stdout:  # type: ignore[union-attr]
+        sys.stdout.write(f"[{tag}] {line.decode(errors='replace')}")
+        sys.stdout.flush()
+
+
+def launch(
+    num_processes: int,
+    train_args: list[str],
+    *,
+    port: int = 0,
+    platform: str | None = None,
+    devices_per_process: int = 1,
+    env_extra: dict[str, str] | None = None,
+) -> int:
+    """Spawn the cluster; return the first nonzero child exit code (0 = all
+    succeeded). Importable — tests and scripts call this directly."""
+    probe = None
+    if not port:
+        port, probe = _free_port()
+    coord = f"localhost:{port}"
+    env = dict(os.environ)
+    if platform == "cpu" and devices_per_process > 1:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={devices_per_process}"
+        )
+    if env_extra:
+        env.update(env_extra)
+
+    procs: list[subprocess.Popen] = []
+    pumps: list[threading.Thread] = []
+    rc = 0
+    try:
+        for i in range(num_processes):
+            cmd = [
+                sys.executable, "-m", "dist_mnist_tpu.cli.train",
+                f"--coordinator_address={coord}",
+                f"--num_processes={num_processes}",
+                f"--process_id={i}",
+                *([f"--platform={platform}"] if platform else []),
+                *train_args,
+            ]
+            p = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
+            )
+            procs.append(p)
+            t = threading.Thread(target=_pump, args=(p, f"p{i}"), daemon=True)
+            t.start()
+            pumps.append(t)
+        # all children exist; release the port for the child coordinator
+        # (children spend seconds in jax import before binding it)
+        if probe is not None:
+            probe.close()
+            probe = None
+        # wait for all; on the first failure kill the survivors (a dead peer
+        # would otherwise park them in collectives until the coordination
+        # service's heartbeat timeout — fail fast instead)
+        alive = set(range(num_processes))
+        while alive:
+            for i in sorted(alive):
+                code = procs[i].poll()
+                if code is None:
+                    continue
+                alive.discard(i)
+                if code != 0 and rc == 0:
+                    rc = code
+                    for j in sorted(alive):
+                        procs[j].terminate()
+            if alive:
+                try:
+                    procs[min(alive)].wait(timeout=0.5)
+                except subprocess.TimeoutExpired:
+                    pass
+    except KeyboardInterrupt:
+        # forward the interrupt and give children a bounded window to
+        # finish in-flight side effects (checkpoint save, log flush)
+        # before the finally-kill
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        deadline = 10.0
+        for p in procs:
+            try:
+                p.wait(timeout=deadline)
+            except subprocess.TimeoutExpired:
+                deadline = 0.1
+        rc = 130
+    finally:
+        if probe is not None:
+            probe.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in pumps:
+            t.join(timeout=5)
+    return rc
+
+
+def main(argv):
+    # argv[1:] (after absl consumed --num_processes etc.) passes through to
+    # cli.train, mirroring `launcher -- --train_flags...`
+    train_args = [a for a in argv[1:] if a != "--"]
+    rc = launch(
+        FLAGS.num_processes,
+        train_args,
+        port=FLAGS.port,
+        platform=FLAGS.platform,
+        devices_per_process=FLAGS.devices_per_process,
+    )
+    if rc:
+        sys.exit(rc)
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -53,6 +53,9 @@ flags.DEFINE_string("checkpoint_dir", None, "checkpoint directory (None = off)")
 flags.DEFINE_string("logdir", None, "metrics/profile output directory")
 flags.DEFINE_string("mesh", None, 'mesh override, e.g. "data=8,model=1"')
 flags.DEFINE_string("coordinator_address", None, "host:port of process 0")
+flags.DEFINE_string("platform", None,
+                    "pin the jax backend (e.g. cpu for the simulated "
+                    "cluster — see cli/launch.py); None = host default")
 flags.DEFINE_integer("num_processes", 1, "total processes (multi-host)")
 flags.DEFINE_integer("process_id", 0, "this process's index")
 flags.DEFINE_boolean("profile", False, "trace a window of steps to logdir")
@@ -288,7 +291,8 @@ def main(argv):
     from dist_mnist_tpu.data import load_dataset
 
     initialize_distributed(
-        FLAGS.coordinator_address, FLAGS.num_processes, FLAGS.process_id
+        FLAGS.coordinator_address, FLAGS.num_processes, FLAGS.process_id,
+        platform=FLAGS.platform,
     )
     cfg = _apply_flag_overrides(get_config(FLAGS.config))
     if FLAGS.download_only:

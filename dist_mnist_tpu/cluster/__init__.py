@@ -18,7 +18,11 @@ from dist_mnist_tpu.cluster.mesh import (
     local_batch_slice,
     device_count,
 )
-from dist_mnist_tpu.cluster.coordination import initialize_distributed, is_chief
+from dist_mnist_tpu.cluster.coordination import (
+    force_platform,
+    initialize_distributed,
+    is_chief,
+)
 
 __all__ = [
     "ClusterConfig",
@@ -27,6 +31,7 @@ __all__ = [
     "activate",
     "local_batch_slice",
     "device_count",
+    "force_platform",
     "initialize_distributed",
     "is_chief",
 ]

@@ -21,10 +21,23 @@ log = logging.getLogger(__name__)
 _initialized = False
 
 
+def force_platform(platform: str) -> None:
+    """Pin the jax backend BEFORE first use (must precede any jax op).
+
+    Needed because site hooks in hosted images may pre-select an
+    accelerator platform; tests and the CPU-simulated cluster
+    (`cli/launch.py --platform=cpu`) must win that fight in-process —
+    the JAX_PLATFORMS env var alone can be overridden by such hooks.
+    """
+    jax.config.update("jax_platforms", platform)
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    *,
+    platform: str | None = None,
 ) -> None:
     """Connect this process to the cluster (no-op single-process).
 
@@ -33,14 +46,26 @@ def initialize_distributed(
     coordination service (heartbeats, "Unavailable: Heartbeat timeout"
     semantics — coordination_service_agent.h:358-365 lineage) detects dead
     peers instead of the PS surviving them.
+
+    `platform="cpu"` additionally selects gloo for cross-process CPU
+    collectives, so an N-process cluster can be exercised on one machine
+    with no accelerator — the analogue of the reference's
+    `create_local_cluster` in-process gRPC servers (SURVEY.md §4), but as
+    real OS processes.
     """
     global _initialized
     if _initialized:
         return
+    if platform:
+        force_platform(platform)
     if coordinator_address is None and (num_processes is None or num_processes <= 1):
         log.info("single-process run; skipping jax.distributed.initialize")
         _initialized = True
         return
+    if platform == "cpu":
+        # cross-process collectives on the CPU backend need an explicit
+        # transport; gloo ships in jaxlib
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
