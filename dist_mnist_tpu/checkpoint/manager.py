@@ -53,7 +53,14 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
         self._last_saved: int | None = None
 
-    def latest_step(self) -> int | None:
+    def latest_step(self, *, refresh: bool = False) -> int | None:
+        """Newest step on disk. Orbax caches the step list at init;
+        `refresh=True` rescans the directory — required when ANOTHER
+        process/manager is writing (GlobalStepWaiterHook's cross-job
+        observation; ≙ re-reading the `checkpoint` state proto,
+        checkpoint_management.py:251)."""
+        if refresh:
+            self._mgr.reload()
         return self._mgr.latest_step()
 
     def save(self, state) -> bool:

@@ -32,7 +32,10 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
+import time
+from pathlib import Path
 
 from absl import app, flags
 
@@ -48,15 +51,47 @@ flags.DEFINE_integer("devices_per_process", 1,
                      "virtual devices per process (cpu platform only)")
 
 
-def _free_port() -> tuple[int, socket.socket]:
-    """Pick a free port and KEEP the probe socket open: the caller holds it
-    until the children are spawned, so two concurrent launch() calls can't
-    be handed the same port (each holds its own while picking). The child
-    coordinator binds seconds later (after jax import) — a closed-and-
-    released port would be a wide race window."""
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    return s.getsockname()[1], s
+_PORT_LOCK_DIR = Path(tempfile.gettempdir()) / "dist_mnist_tpu_ports"
+_PORT_LOCK_STALE_SECS = 3600.0
+
+
+def _reserve_port() -> tuple[int, socket.socket, Path]:
+    """Pick a free port with a cross-process reservation.
+
+    The gap between this pick and the child coordinator's bind is SECONDS
+    wide (children pay the jax import first), so an OS-level free-port probe
+    alone is a race. Two layers close it against the realistic contender —
+    other launch() invocations on this machine (parallel pytest, CI shards):
+
+    1. the probe socket stays open until the children are spawned, so
+       concurrent pickers can't be handed the same port by the OS;
+    2. an O_EXCL lockfile keyed by port number covers the
+       probe-closed -> child-bound window; it is held until the cluster
+       exits. Stale locks (launcher SIGKILLed) expire after an hour.
+
+    Unrelated third-party processes binding random ports in that window
+    remain theoretically possible — children then fail to handshake and the
+    launcher reports it (no silent cross-wiring: the coordinator checks
+    num_processes/process_id consistency).
+    """
+    _PORT_LOCK_DIR.mkdir(exist_ok=True)
+    now = time.time()
+    for stale in _PORT_LOCK_DIR.iterdir():
+        try:
+            if now - stale.stat().st_mtime > _PORT_LOCK_STALE_SECS:
+                stale.unlink()
+        except OSError:
+            pass
+    while True:
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        lock = _PORT_LOCK_DIR / str(port)
+        try:
+            os.close(os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            return port, s, lock
+        except FileExistsError:
+            s.close()  # reserved by a concurrent launcher; try another
 
 
 def _pump(proc: subprocess.Popen, tag: str) -> None:
@@ -78,9 +113,9 @@ def launch(
 ) -> int:
     """Spawn the cluster; return the first nonzero child exit code (0 = all
     succeeded). Importable — tests and scripts call this directly."""
-    probe = None
+    probe, lock = None, None
     if not port:
-        port, probe = _free_port()
+        port, probe, lock = _reserve_port()
     coord = f"localhost:{port}"
     env = dict(os.environ)
     if platform == "cpu" and devices_per_process > 1:
@@ -157,13 +192,44 @@ def launch(
                 p.kill()
         for t in pumps:
             t.join(timeout=5)
+        if lock is not None:
+            try:
+                lock.unlink()
+            except OSError:
+                pass
     return rc
 
 
+#: launcher-owned / per-child flags that must NOT be blanket-forwarded
+_UNFORWARDED = {
+    "port", "devices_per_process", "num_processes", "platform",
+    "coordinator_address", "process_id",
+}
+
+
+def _forwarded_train_flags() -> list[str]:
+    """Serialize train flags the user set on the LAUNCHER's command line.
+
+    Because cli.train is imported here, absl parses its flags wherever they
+    appear — `launch --num_processes=2 --train_steps=500` consumes
+    --train_steps into this process's FLAGS instead of leaving it in argv.
+    Forwarding every explicitly-set train-module flag keeps both styles
+    working (before or after `--`)."""
+    out = []
+    for module, flag_list in FLAGS.flags_by_module_dict().items():
+        if not module.endswith("cli.train"):
+            continue
+        for fl in flag_list:
+            if fl.present and fl.name not in _UNFORWARDED:
+                out.append(fl.serialize())
+    return out
+
+
 def main(argv):
-    # argv[1:] (after absl consumed --num_processes etc.) passes through to
-    # cli.train, mirroring `launcher -- --train_flags...`
-    train_args = [a for a in argv[1:] if a != "--"]
+    # explicitly-set train flags absl already consumed, then any literal
+    # passthrough after `--` (duplicates are fine: the later, explicit
+    # occurrence wins in the child's absl parse)
+    train_args = _forwarded_train_flags() + [a for a in argv[1:] if a != "--"]
     rc = launch(
         FLAGS.num_processes,
         train_args,

@@ -17,6 +17,8 @@ from dist_mnist_tpu.hooks.builtin import (
     SummaryHook,
     ProfilerHook,
     EvalHook,
+    GlobalStepWaiterHook,
+    FinalOpsHook,
 )
 
 __all__ = [
@@ -30,4 +32,6 @@ __all__ = [
     "SummaryHook",
     "ProfilerHook",
     "EvalHook",
+    "GlobalStepWaiterHook",
+    "FinalOpsHook",
 ]

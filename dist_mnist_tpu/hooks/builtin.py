@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import inspect
 import logging
 import math
 import time
@@ -191,11 +192,103 @@ class ProfilerHook(Hook):
             self._active = False
             log.info("profile for steps [%d, %d) -> %s",
                      self._start, self._stop, self._logdir)
+            try:
+                # reference UX parity: a chrome://tracing-loadable
+                # timeline-*.json next to the profile (obs/timeline.py)
+                from dist_mnist_tpu.obs.timeline import export_chrome_trace
+
+                out = export_chrome_trace(self._logdir)
+                if out is not None:
+                    log.info("chrome trace -> %s", out)
+            except Exception:  # noqa: BLE001 — triage aid must not kill training
+                log.exception("chrome trace export failed")
 
     def end(self, state):
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+
+
+class GlobalStepWaiterHook(Hook):
+    """≙ GlobalStepWaiterHook (basic_session_run_hooks.py:902): delay this
+    process's training until the job's global step reaches `wait_until_step`.
+
+    The reference polled the PS-resident global_step variable (the only
+    cross-worker channel); under SPMD the cross-JOB channel is the
+    checkpoint directory, so this polls `checkpoint_manager.latest_step()`.
+    A state already restored at/past the threshold passes immediately.
+    Typical use: stagger a follower job (eval/export/continuation) until a
+    trainer job's checkpoints reach step N.
+    """
+
+    def __init__(self, wait_until_step: int, checkpoint_manager=None,
+                 poll_secs: float = 0.5, timeout_secs: float | None = None,
+                 log_every_secs: float = 10.0):
+        self._wait_until = wait_until_step
+        self._mgr = checkpoint_manager
+        self._poll = poll_secs
+        self._timeout = timeout_secs
+        self._log_every = log_every_secs
+
+    def begin(self, loop):
+        if self._wait_until <= 0 or loop.initial_step >= self._wait_until:
+            return
+        if self._mgr is None:
+            raise ValueError(
+                "GlobalStepWaiterHook needs a checkpoint_manager to observe "
+                "another job's progress (no shared global_step exists)"
+            )
+        log.info("waiting for global step %d...", self._wait_until)
+        t0 = last_log = time.monotonic()
+        # a FOREIGN job is writing the checkpoints, so each poll must rescan
+        # the directory — cached step lists (orbax caches at init) would spin
+        # forever. Our CheckpointManager: latest_step(refresh=True); bare
+        # orbax managers: reload() first; fakes: plain latest_step().
+        try:
+            has_refresh = "refresh" in inspect.signature(
+                self._mgr.latest_step
+            ).parameters
+        except (TypeError, ValueError):
+            has_refresh = False
+        reload_fn = getattr(self._mgr, "reload", None)
+
+        def poll():
+            if has_refresh:
+                return self._mgr.latest_step(refresh=True)
+            if callable(reload_fn):
+                reload_fn()
+            return self._mgr.latest_step()
+
+        while True:
+            latest = poll()
+            if latest is not None and latest >= self._wait_until:
+                log.info("global step %d reached (%.1fs)", latest,
+                         time.monotonic() - t0)
+                return
+            now = time.monotonic()
+            if self._timeout is not None and now - t0 > self._timeout:
+                raise TimeoutError(
+                    f"global step {self._wait_until} not reached in "
+                    f"{self._timeout}s (latest={latest})"
+                )
+            if now - last_log >= self._log_every:
+                # reference cadence: a progress line every 10 s (:986-994)
+                log.info("still waiting for step %d (latest=%s)",
+                         self._wait_until, latest)
+                last_log = now
+            time.sleep(self._poll)
+
+
+class FinalOpsHook(Hook):
+    """≙ FinalOpsHook (basic_session_run_hooks.py:1098): evaluate one last
+    thing on the final state; result kept on `.final_result`."""
+
+    def __init__(self, final_fn):
+        self._fn = final_fn
+        self.final_result = None
+
+    def end(self, state):
+        self.final_result = self._fn(state)
 
 
 class EvalHook(Hook):

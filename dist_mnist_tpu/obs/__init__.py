@@ -1,5 +1,5 @@
 """Observability: metric writers (tf.summary / SummaryWriterCache analogue,
-SURVEY.md §5.5)."""
+SURVEY.md §5.5) and chrome-trace export (client/timeline.py analogue, §5.1)."""
 
 from dist_mnist_tpu.obs.writers import (
     MetricWriter,
@@ -9,6 +9,11 @@ from dist_mnist_tpu.obs.writers import (
     MultiWriter,
     make_default_writer,
 )
+from dist_mnist_tpu.obs.timeline import (
+    latest_trace,
+    export_chrome_trace,
+    summarize_trace,
+)
 
 __all__ = [
     "MetricWriter",
@@ -17,4 +22,7 @@ __all__ = [
     "TensorBoardWriter",
     "MultiWriter",
     "make_default_writer",
+    "latest_trace",
+    "export_chrome_trace",
+    "summarize_trace",
 ]

@@ -1,0 +1,84 @@
+"""Chrome-trace export + op-time triage.
+
+Replaces the reference's Timeline path (SURVEY.md §5.1): there,
+`ProfilerHook` requested FULL_TRACE RunMetadata and
+`client/timeline.py:410` (`generate_chrome_trace_format:825`) converted the
+returned step_stats into `timeline-<step>.json` for chrome://tracing.
+
+`jax.profiler` already captures a superset (XLA ops, ICI collectives, host
+runtime) but buries it as TensorBoard plugin data
+(`<logdir>/plugins/profile/<run>/*.trace.json.gz`). The two functions here
+close the gap to the reference's UX:
+
+- `export_chrome_trace(logdir, out)` -> the literal `timeline-*.json` file
+  the reference emitted, loadable in chrome://tracing / perfetto.
+- `summarize_trace(path, top)` -> top-N ops by self device time, for triage
+  on machines with no TensorBoard reachable (this box: zero egress).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from collections import defaultdict
+from pathlib import Path
+
+__all__ = ["latest_trace", "export_chrome_trace", "summarize_trace"]
+
+
+def latest_trace(logdir: str | Path) -> Path | None:
+    """Newest .trace.json.gz under a jax.profiler logdir (None if absent)."""
+    candidates = sorted(
+        Path(logdir).glob("plugins/profile/*/*.trace.json.gz"),
+        key=lambda p: p.stat().st_mtime,
+    )
+    return candidates[-1] if candidates else None
+
+
+def export_chrome_trace(
+    logdir: str | Path, out_path: str | Path | None = None
+) -> Path | None:
+    """Decompress the latest profiler trace to `timeline-<run>.json`.
+
+    Returns the written path, or None when no trace exists yet. Naming
+    mirrors the reference's `timeline-<step>.json` files."""
+    src = latest_trace(logdir)
+    if src is None:
+        return None
+    if out_path is None:
+        out_path = Path(logdir) / f"timeline-{src.parent.name}.json"
+    out_path = Path(out_path)
+    out_path.write_bytes(gzip.decompress(src.read_bytes()))
+    return out_path
+
+
+def summarize_trace(
+    trace_path: str | Path, top: int = 15
+) -> list[dict[str, float | str | int]]:
+    """Aggregate complete events by name: total duration, count.
+
+    Works on either the raw `.trace.json.gz` or an exported timeline JSON.
+    Returns rows sorted by total time, descending:
+    `{"name", "total_us", "count", "avg_us"}`.
+    """
+    raw = Path(trace_path).read_bytes()
+    if str(trace_path).endswith(".gz"):
+        raw = gzip.decompress(raw)
+    events = json.loads(raw).get("traceEvents", [])
+    total = defaultdict(float)
+    count = defaultdict(int)
+    for ev in events:
+        if ev.get("ph") == "X" and "dur" in ev:
+            name = ev.get("name", "?")
+            total[name] += ev["dur"]
+            count[name] += 1
+    rows = sorted(total, key=total.__getitem__, reverse=True)[:top]
+    return [
+        {
+            "name": n,
+            "total_us": round(total[n], 1),
+            "count": count[n],
+            "avg_us": round(total[n] / count[n], 2),
+        }
+        for n in rows
+    ]

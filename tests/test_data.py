@@ -152,3 +152,86 @@ def test_native_batcher_start_step_seeks(mesh8, small_mnist):
         np.testing.assert_array_equal(img, imgs[k + i][0])
         np.testing.assert_array_equal(lab, imgs[k + i][1])
     b2.close()
+
+
+# ---- property tests (SURVEY.md §4: hypothesis for the sharding math) -------
+
+from hypothesis import given, settings, strategies as st
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    n=st.integers(min_value=1, max_value=2000),
+    batch=st.integers(min_value=1, max_value=128),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+    epoch=st.integers(min_value=0, max_value=100),
+)
+def test_epoch_batches_cover_without_repeat(n, batch, seed, epoch):
+    """Every epoch is a permutation prefix: batches are disjoint, sizes
+    exact, indices in range, and the same (seed, epoch) is bitwise stable
+    across calls (the cross-host agreement contract)."""
+    from dist_mnist_tpu.data.pipeline import epoch_batches
+
+    batches = list(epoch_batches(n, batch, seed=seed, epoch=epoch))
+    assert len(batches) == n // batch
+    seen = np.concatenate(batches) if batches else np.array([], np.int64)
+    assert len(set(seen.tolist())) == len(seen)  # no repeats
+    assert all(b.shape == (batch,) for b in batches)
+    if len(seen):
+        assert seen.min() >= 0 and seen.max() < n
+    again = list(epoch_batches(n, batch, seed=seed, epoch=epoch))
+    assert all((a == b).all() for a, b in zip(batches, again))
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    per_dev=st.integers(min_value=1, max_value=64),
+    data_axis=st.sampled_from([1, 2, 4, 8]),
+)
+def test_local_batch_slice_partitions(per_dev, data_axis):
+    """process slice x process count == global == device slice x axis size."""
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, local_batch_slice, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=data_axis),
+                     devices=jax.devices()[:data_axis])
+    global_batch = per_dev * data_axis
+    per_proc, per_device = local_batch_slice(global_batch, mesh)
+    assert per_device == per_dev
+    assert per_proc * jax.process_count() == global_batch
+    assert per_device * data_axis == global_batch
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    n=st.integers(min_value=8, max_value=512),
+    batch=st.integers(min_value=1, max_value=64),
+    ckpt_step=st.integers(min_value=0, max_value=300),
+)
+def test_batcher_seek_is_pure_function_of_step(n, batch, ckpt_step):
+    """at_step(k) must reproduce the exact index sequence an uninterrupted
+    run sees from step k (the checkpoint-resume data-stream contract;
+    pipeline.py 'resume exactly where a restored step left off')."""
+    from dist_mnist_tpu.data.pipeline import epoch_batches
+
+    steps_per_epoch = n // batch
+    if steps_per_epoch == 0:
+        return
+
+    def stream_from(step, count=4):
+        epoch, skip = divmod(step, steps_per_epoch)
+        out = []
+        while len(out) < count:
+            for b, idx in enumerate(epoch_batches(n, batch, seed=7, epoch=epoch)):
+                if b < skip:
+                    continue
+                out.append(idx)
+                if len(out) == count:
+                    break
+            skip = 0
+            epoch += 1
+        return out
+
+    uninterrupted = stream_from(0, count=min(ckpt_step, 50) + 4)
+    resumed = stream_from(min(ckpt_step, 50), count=4)
+    tail = uninterrupted[min(ckpt_step, 50):]
+    assert all((a == b).all() for a, b in zip(tail, resumed))

@@ -202,3 +202,81 @@ def test_eval_hook_no_double_eval_when_final_on_cadence():
                      [hook, StopAtStepHook(last_step=8)])
     loop.run()
     assert evals == [4, 8]  # end() skipped: step 8 already evaluated
+
+
+class _FakeMgr:
+    """latest_step advances each poll — a trainer job making progress."""
+
+    def __init__(self, steps):
+        self._steps = iter(steps)
+        self.polls = 0
+
+    def latest_step(self):
+        self.polls += 1
+        return next(self._steps)
+
+
+def test_global_step_waiter_blocks_until_step():
+    from dist_mnist_tpu.hooks import GlobalStepWaiterHook
+
+    mgr = _FakeMgr([None, 2, 4, 5, 99])
+    hook = GlobalStepWaiterHook(5, checkpoint_manager=mgr, poll_secs=0.0)
+    loop = TrainLoop(_fake_step, _state(), iter([1.0]), [hook])
+    loop.run()
+    assert mgr.polls == 4  # stopped polling the moment 5 was reached
+
+
+def test_global_step_waiter_passes_if_restored_past():
+    from dist_mnist_tpu.hooks import GlobalStepWaiterHook
+
+    mgr = _FakeMgr([])
+    hook = GlobalStepWaiterHook(5, checkpoint_manager=mgr, poll_secs=0.0)
+    loop = TrainLoop(_fake_step, _state(step=9), iter([1.0]), [hook])
+    loop.run()
+    assert mgr.polls == 0
+
+
+def test_global_step_waiter_timeout():
+    from dist_mnist_tpu.hooks import GlobalStepWaiterHook
+
+    mgr = _FakeMgr(itertools.repeat(1))
+    hook = GlobalStepWaiterHook(5, checkpoint_manager=mgr, poll_secs=0.0,
+                                timeout_secs=0.05)
+    loop = TrainLoop(_fake_step, _state(), iter([1.0]), [hook])
+    with pytest.raises(TimeoutError):
+        loop.run()
+
+
+def test_final_ops_hook():
+    from dist_mnist_tpu.hooks import FinalOpsHook
+
+    hook = FinalOpsHook(lambda state: state.step_int * 10)
+    loop = TrainLoop(_fake_step, _state(), iter([1.0, 1.0]), [hook])
+    loop.run()
+    assert hook.final_result == 20
+
+
+def test_global_step_waiter_reloads_bare_managers():
+    """A manager without latest_step(refresh=) but with reload() (bare orbax)
+    must be rescanned each poll — a cached step list would spin forever."""
+    from dist_mnist_tpu.hooks import GlobalStepWaiterHook
+
+    class _BareMgr:
+        def __init__(self):
+            self._on_disk = None
+            self.reloads = 0
+
+        def reload(self):
+            self.reloads += 1
+            if self.reloads >= 3:  # a foreign trainer reaches step 7
+                self._on_disk = 7
+
+        def latest_step(self):
+            return self._on_disk
+
+    mgr = _BareMgr()
+    hook = GlobalStepWaiterHook(5, checkpoint_manager=mgr, poll_secs=0.0,
+                                timeout_secs=5.0)
+    loop = TrainLoop(_fake_step, _state(), iter([1.0]), [hook])
+    loop.run()
+    assert mgr.reloads == 3

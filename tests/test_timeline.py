@@ -1,0 +1,62 @@
+"""Chrome-trace export (obs/timeline.py — client/timeline.py analogue)."""
+
+import gzip
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dist_mnist_tpu.obs import export_chrome_trace, latest_trace, summarize_trace
+
+
+@pytest.fixture(scope="module")
+def profile_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("prof")
+    with jax.profiler.trace(str(d)):
+        x = jnp.ones((256, 256))
+        jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    return d
+
+
+def test_latest_trace_found(profile_dir):
+    assert latest_trace(profile_dir) is not None
+
+
+def test_export_chrome_trace(profile_dir, tmp_path):
+    out = export_chrome_trace(profile_dir)
+    assert out is not None and out.name.startswith("timeline-")
+    data = json.loads(out.read_text())
+    assert "traceEvents" in data and len(data["traceEvents"]) > 0
+
+
+def test_export_no_trace_returns_none(tmp_path):
+    assert export_chrome_trace(tmp_path) is None
+    assert latest_trace(tmp_path) is None
+
+
+def test_summarize_trace(profile_dir):
+    rows = summarize_trace(latest_trace(profile_dir))
+    assert rows, "profiler produced no complete events"
+    assert rows == sorted(rows, key=lambda r: -r["total_us"])
+    for r in rows:
+        # total is rounded to 1 dp, avg to 2 dp — allow the rounding gap
+        assert r["count"] >= 1 and r["avg_us"] <= r["total_us"] + 0.06
+
+
+def test_summarize_synthetic_trace(tmp_path):
+    """Deterministic check of aggregation math on a hand-written trace."""
+    trace = {
+        "traceEvents": [
+            {"ph": "X", "name": "matmul", "dur": 10.0},
+            {"ph": "X", "name": "matmul", "dur": 30.0},
+            {"ph": "X", "name": "relu", "dur": 5.0},
+            {"ph": "M", "name": "meta-only"},
+        ]
+    }
+    p = tmp_path / "t.trace.json.gz"
+    p.write_bytes(gzip.compress(json.dumps(trace).encode()))
+    rows = summarize_trace(p)
+    assert rows[0] == {"name": "matmul", "total_us": 40.0, "count": 2,
+                       "avg_us": 20.0}
+    assert rows[1]["name"] == "relu"
