@@ -13,10 +13,15 @@ reference's per-step feed_dict -> gRPC -> PS round-trip (§3.3).
 north star "≥99% MNIST test accuracy in <60 s wall-clock". We time the
 accuracy race (training start -> first eval ≥99%, compile included) and
 report vs_baseline = 60s / wall_to_99 (>1 = beating the target).
+
+Ladder mode (`python bench.py --config resnet20_cifar [--steps N]`) times
+any BASELINE.md config's steady-state steps/sec/chip with the same fused
+machinery — the default invocation (what the driver runs) is unchanged.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -24,13 +29,64 @@ import time
 import jax
 
 
-def main():
-    # persistent XLA compile cache: repeat invocations skip the ~45 s of
-    # scan/init/eval compiles entirely (cold-compile time still counts
-    # against wall_to_99 on the first run — reported honestly either way)
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+def bench_config(name: str, n_timed: int):
+    """Steady-state throughput for one ladder config (no accuracy race —
+    only the headline MNIST config has a published accuracy target).
 
+    Times the config's REAL training step: optimizer pipeline (schedule,
+    clipping, weight decay, accumulation) via cli.train.build_optimizer and
+    the config's loss — not a simplified stand-in."""
+    from dist_mnist_tpu.cli.train import build_optimizer
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.data import DeviceDataset, load_dataset
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.ops import losses
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state
+    from dist_mnist_tpu.train.step import make_scanned_train_fn
+
+    cfg = get_config(name)
+    n_chips = jax.device_count()
+    mesh = make_mesh(MeshSpec(data=-1))  # whatever this box has
+    dataset = load_dataset(cfg.dataset, "/tmp/mnist-data", seed=cfg.seed)
+    model = get_model(cfg.model, **cfg.model_kwargs)
+    optimizer = build_optimizer(cfg)
+    loss_fn = (losses.clipped_softmax_cross_entropy if cfg.loss == "clipped"
+               else losses.softmax_cross_entropy)
+    chunk = 100
+    with mesh:
+        state = create_train_state(
+            model, optimizer, jax.random.PRNGKey(0), dataset.train_images[:1]
+        )
+        state = shard_train_state(state, mesh)
+        dd = DeviceDataset(dataset, mesh)
+        run = make_scanned_train_fn(model, optimizer, mesh, dd,
+                                    cfg.batch_size, chunk, loss_fn=loss_fn)
+        state, out = run(state)  # compile + warmup
+        jax.block_until_ready(out["loss"])
+        t0 = time.monotonic()
+        for _ in range(max(1, n_timed // chunk)):
+            state, out = run(state)
+        jax.block_until_ready(out["loss"])
+        dt = time.monotonic() - t0
+    rate = max(1, n_timed // chunk) * chunk / dt / n_chips
+    print(json.dumps({
+        "metric": f"{name}_steps_per_sec_per_chip",
+        "value": round(rate, 2),
+        "unit": "steps/sec/chip",
+        "vs_baseline": 0.0,  # no published reference numbers (BASELINE.md)
+        "extra": {
+            "chips": n_chips,
+            "global_batch": cfg.batch_size,
+            "examples_per_sec": round(rate * n_chips * cfg.batch_size),
+            "synthetic_data": dataset.synthetic,
+        },
+    }))
+    return 0
+
+
+def main():
     from dist_mnist_tpu import optim
     from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
     from dist_mnist_tpu.data import DeviceDataset, load_dataset
@@ -102,4 +158,19 @@ def main():
 
 
 if __name__ == "__main__":
+    # persistent XLA compile cache for BOTH modes: repeat invocations skip
+    # the ~45 s of scan/init/eval compiles entirely (cold-compile time still
+    # counts against wall_to_99 on the first run — reported honestly)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None,
+                    help="ladder config to time (default: headline LeNet-5 "
+                         "accuracy race + throughput)")
+    ap.add_argument("--steps", type=int, default=500,
+                    help="timed steps in --config mode")
+    args = ap.parse_args()
+    if args.config:
+        sys.exit(bench_config(args.config, args.steps))
     sys.exit(main())

@@ -16,12 +16,15 @@ Axes (any may be size 1 and is then squeezed out of collectives by XLA):
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+log = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -82,6 +85,35 @@ def device_count() -> int:
     return jax.device_count()
 
 
+def slice_count(devices: Sequence[jax.Device]) -> int:
+    """Number of distinct TPU slices among `devices` (1 when the backend
+    doesn't expose `slice_index` — CPU, single slice, older libtpu)."""
+    idx = {getattr(d, "slice_index", None) for d in devices}
+    return 1 if None in idx else max(len(idx), 1)
+
+
+def hybrid_mesh_shapes(
+    shape: tuple[int, int, int, int], num_slices: int
+) -> tuple[tuple[int, int, int, int], tuple[int, int, int, int]]:
+    """Factor a resolved (data, model, seq, pipe) shape into per-slice ICI
+    and cross-slice DCN shapes for `mesh_utils.create_hybrid_device_mesh`.
+
+    The DATA axis takes the DCN factor (its gradient all-reduce is the only
+    per-step collective that tolerates DCN latency — one hierarchical psum:
+    reduce-scatter inside each slice over ICI, all-reduce the partial across
+    slices over DCN, all-gather back over ICI; XLA decomposes it given this
+    device order). model/seq/pipe collectives are latency-critical and must
+    stay inside a slice.
+    """
+    data, model, seq, pipe = shape
+    if data % num_slices:
+        raise ValueError(
+            f"data axis {data} must be a multiple of the slice count "
+            f"{num_slices} (the cross-slice mesh factor rides DCN)"
+        )
+    return (data // num_slices, model, seq, pipe), (num_slices, 1, 1, 1)
+
+
 def make_mesh(
     spec: MeshSpec | None = None,
     *,
@@ -93,7 +125,10 @@ def make_mesh(
     Uses ``jax.experimental.mesh_utils`` device ordering when available so
     that the ``data`` axis rides the slowest links and ``model``/``seq``
     (which carry per-step collectives with tighter latency needs) ride
-    contiguous ICI neighbours.
+    contiguous ICI neighbours. On a multislice topology (devices report
+    distinct ``slice_index``), the mesh is hybrid: the data axis's
+    cross-slice factor is laid out over DCN and everything else stays
+    inside a slice (`hybrid_mesh_shapes`).
     """
     spec = spec or MeshSpec()
     devices = list(devices if devices is not None else jax.devices())
@@ -111,11 +146,29 @@ def make_mesh(
     # Squeeze trailing singleton axes out of the mesh? No — keep all four
     # axes so PartitionSpecs are uniform across configs; XLA elides
     # collectives over size-1 axes.
+    # shape/slice-count mismatches are CONFIG errors and must surface —
+    # only layout-library failures may fall back to a naive order below
+    n_slices = slice_count(devices)
+    if n_slices > 1:
+        ici_shape, dcn_shape = hybrid_mesh_shapes(shape, n_slices)
     try:
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        if n_slices > 1:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices
+            )
+        else:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:  # non-TPU backends can reject topology-aware layout
+        if n_slices > 1:
+            # a naive order on real multislice silently puts latency-
+            # critical axes on DCN — never do that without saying so
+            log.warning(
+                "topology-aware hybrid mesh layout failed on a %d-slice "
+                "topology; falling back to enumeration order — per-step "
+                "collectives may cross DCN", n_slices, exc_info=True,
+            )
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, axis_names=axis_names)
 

@@ -92,3 +92,24 @@ def test_opt_state_inherits_param_specs(mesh_tp):
     assert s["opt"]["m"]["mlp_in"]["w"].spec == P(None, "model")
     assert s["opt"]["v"]["mlp_in"]["w"].spec == P(None, "model")
     assert s["opt"]["count"].spec == P()
+
+
+def test_hybrid_mesh_shapes():
+    """Multislice factoring: DCN factor rides the data axis only."""
+    from dist_mnist_tpu.cluster.mesh import hybrid_mesh_shapes, slice_count
+
+    ici, dcn = hybrid_mesh_shapes((8, 2, 1, 1), num_slices=2)
+    assert ici == (4, 2, 1, 1)
+    assert dcn == (2, 1, 1, 1)
+    # elementwise product reassembles the logical shape
+    assert tuple(a * b for a, b in zip(ici, dcn)) == (8, 2, 1, 1)
+
+    with pytest.raises(ValueError):
+        hybrid_mesh_shapes((6, 1, 1, 1), num_slices=4)
+
+    class _Dev:
+        def __init__(self, slice_index=None):
+            self.slice_index = slice_index
+
+    assert slice_count([_Dev(0), _Dev(0), _Dev(1)]) == 2
+    assert slice_count([_Dev(None), _Dev(None)]) == 1
