@@ -94,24 +94,24 @@ def slice_count(devices: Sequence[jax.Device]) -> int:
 
 def hybrid_mesh_shapes(
     shape: tuple[int, int, int, int], num_slices: int
-) -> tuple[tuple[int, int, int, int], tuple[int, int, int, int]]:
+) -> tuple[tuple[int, int, int, int], tuple[int, int, int, int]] | None:
     """Factor a resolved (data, model, seq, pipe) shape into per-slice ICI
     and cross-slice DCN shapes for `mesh_utils.create_hybrid_device_mesh`.
 
-    The DATA axis takes the DCN factor (its gradient all-reduce is the only
-    per-step collective that tolerates DCN latency — one hierarchical psum:
-    reduce-scatter inside each slice over ICI, all-reduce the partial across
-    slices over DCN, all-gather back over ICI; XLA decomposes it given this
-    device order). model/seq/pipe collectives are latency-critical and must
-    stay inside a slice.
+    The DCN factor goes on the DATA axis when it divides it (gradient
+    all-reduce tolerates DCN latency — hierarchical psum: reduce-scatter
+    inside each slice over ICI, all-reduce partials across slices over DCN,
+    all-gather back over ICI), else on the PIPE axis (GPipe activation
+    point-to-point is likewise DCN-tolerant). model/seq collectives are
+    latency-critical and always stay inside a slice. Returns None when
+    neither axis can absorb the slice count — caller decides the fallback.
     """
     data, model, seq, pipe = shape
-    if data % num_slices:
-        raise ValueError(
-            f"data axis {data} must be a multiple of the slice count "
-            f"{num_slices} (the cross-slice mesh factor rides DCN)"
-        )
-    return (data // num_slices, model, seq, pipe), (num_slices, 1, 1, 1)
+    if data % num_slices == 0:
+        return (data // num_slices, model, seq, pipe), (num_slices, 1, 1, 1)
+    if pipe % num_slices == 0:
+        return (data, model, seq, pipe // num_slices), (1, 1, 1, num_slices)
+    return None
 
 
 def make_mesh(
@@ -146,15 +146,22 @@ def make_mesh(
     # Squeeze trailing singleton axes out of the mesh? No — keep all four
     # axes so PartitionSpecs are uniform across configs; XLA elides
     # collectives over size-1 axes.
-    # shape/slice-count mismatches are CONFIG errors and must surface —
-    # only layout-library failures may fall back to a naive order below
     n_slices = slice_count(devices)
-    if n_slices > 1:
-        ici_shape, dcn_shape = hybrid_mesh_shapes(shape, n_slices)
+    hybrid = hybrid_mesh_shapes(shape, n_slices) if n_slices > 1 else None
+    if n_slices > 1 and hybrid is None:
+        # neither DCN-tolerant axis (data, pipe) can absorb the slice
+        # count: the mesh is still legal, but model/seq collectives will
+        # cross DCN — build it, loudly
+        log.warning(
+            "mesh %s cannot place the %d-slice DCN factor on the data or "
+            "pipe axis; latency-critical collectives may cross DCN",
+            dict(zip(axis_names, shape)), n_slices,
+        )
     try:
         from jax.experimental import mesh_utils
 
-        if n_slices > 1:
+        if hybrid is not None:
+            ici_shape, dcn_shape = hybrid
             dev_array = mesh_utils.create_hybrid_device_mesh(
                 ici_shape, dcn_shape, devices=devices
             )
@@ -165,9 +172,9 @@ def make_mesh(
             # a naive order on real multislice silently puts latency-
             # critical axes on DCN — never do that without saying so
             log.warning(
-                "topology-aware hybrid mesh layout failed on a %d-slice "
-                "topology; falling back to enumeration order — per-step "
-                "collectives may cross DCN", n_slices, exc_info=True,
+                "topology-aware mesh layout failed on a %d-slice topology; "
+                "falling back to enumeration order — per-step collectives "
+                "may cross DCN", n_slices, exc_info=True,
             )
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, axis_names=axis_names)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+import chex
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
@@ -31,6 +32,14 @@ LossFn = Callable[..., jax.Array]
 def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
                 dropout_key, *, with_grad_norm: bool = False):
     """The shared fwd+bwd+update body every step variant compiles."""
+    # Structural guards (SURVEY.md §5.2): trace-time only — zero runtime
+    # cost under jit. The reference's analogue was graph finalization +
+    # the accumulator's staleness check; in a pure program the remaining
+    # race class is feeding a malformed batch.
+    chex.assert_rank(batch["image"], 4)  # NHWC
+    chex.assert_rank(batch["label"], 1)
+    chex.assert_type(batch["label"], int)
+    chex.assert_equal_shape_prefix([batch["image"], batch["label"]], 1)
     x = batch["image"].astype(jnp.float32) / 255.0
     y = batch["label"]
 

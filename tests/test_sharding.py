@@ -104,8 +104,13 @@ def test_hybrid_mesh_shapes():
     # elementwise product reassembles the logical shape
     assert tuple(a * b for a, b in zip(ici, dcn)) == (8, 2, 1, 1)
 
-    with pytest.raises(ValueError):
-        hybrid_mesh_shapes((6, 1, 1, 1), num_slices=4)
+    # data axis can't absorb the slices -> pipe (also DCN-tolerant) takes it
+    ici, dcn = hybrid_mesh_shapes((1, 2, 1, 4), num_slices=2)
+    assert ici == (1, 2, 1, 2)
+    assert dcn == (1, 1, 1, 2)
+
+    # neither data nor pipe divisible -> None (caller warns + plain layout)
+    assert hybrid_mesh_shapes((6, 1, 1, 1), num_slices=4) is None
 
     class _Dev:
         def __init__(self, slice_index=None):

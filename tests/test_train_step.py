@@ -4,6 +4,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dist_mnist_tpu import optim
 from dist_mnist_tpu.data.pipeline import shard_batch
@@ -159,3 +160,24 @@ def test_fused_train_step(mesh8, small_mnist):
             losses.append(float(out["loss"]))
     assert losses[-1] < losses[0] * 0.5
     assert state.step_int == 30
+
+
+def test_malformed_batch_rejected_at_trace_time(mesh8, small_mnist):
+    """§5.2 structural guards: a wrong-rank / wrong-dtype batch fails at
+    trace time with a chex error, not with a silent broadcast."""
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.train import create_train_state, make_train_step
+
+    model = get_model("mlp")
+    opt = optim.adam(1e-3)
+    with mesh8:
+        state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                   small_mnist.train_images[:1])
+        step = make_train_step(model, opt, mesh8, donate=False)
+        imgs = small_mnist.train_images[:8]
+        with pytest.raises(AssertionError):
+            step(state, {"image": imgs.reshape(8, -1),  # rank 2, not NHWC
+                         "label": small_mnist.train_labels[:8]})
+        with pytest.raises(AssertionError):
+            step(state, {"image": imgs,
+                         "label": small_mnist.train_labels[:8].astype("float32")})
