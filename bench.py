@@ -62,7 +62,8 @@ def bench_config(name: str, n_timed: int):
         state = shard_train_state(state, mesh)
         dd = DeviceDataset(dataset, mesh)
         run = make_scanned_train_fn(model, optimizer, mesh, dd,
-                                    cfg.batch_size, chunk, loss_fn=loss_fn)
+                                    cfg.batch_size, chunk, loss_fn=loss_fn,
+                                    remat=cfg.remat)
         state, out = run(state)  # compile + warmup
         jax.block_until_ready(out["loss"])
         t0 = time.monotonic()

@@ -168,7 +168,8 @@ def run_config(
             cfg.name, cfg.model, jax.device_count(), restored,
         )
 
-        step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn)
+        step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn,
+                                  remat=cfg.remat)
         eval_step = make_eval_step(model, mesh)
         eval_fn = lambda s: evaluate(
             eval_step, s, dataset.test_images, dataset.test_labels, mesh

@@ -111,6 +111,12 @@ def hybrid_mesh_shapes(
         return (data // num_slices, model, seq, pipe), (num_slices, 1, 1, 1)
     if pipe % num_slices == 0:
         return (data, model, seq, pipe // num_slices), (1, 1, 1, num_slices)
+    # split the slice factor across BOTH DCN-tolerant axes (e.g. 4 slices
+    # over data=2, pipe=2)
+    d = math.gcd(data, num_slices)
+    rest = num_slices // d
+    if d > 1 and pipe % rest == 0:
+        return (data // d, model, seq, pipe // rest), (d, 1, 1, rest)
     return None
 
 

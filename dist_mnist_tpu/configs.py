@@ -32,6 +32,7 @@ class Config:
     replicas_to_aggregate: int = 1  # >1 => gradient accumulation (optim/sync.py)
     grad_clip_norm: float | None = None
     weight_decay: float = 0.0
+    remat: bool = False  # jax.checkpoint the forward (HBM <-> FLOPs trade)
     eval_every: int = 1000
     log_every: int = 100
     checkpoint_every_secs: float = 600.0  # CheckpointSaverHook default cadence
@@ -96,6 +97,7 @@ CONFIGS = {
         warmup_steps=500,
         grad_clip_norm=1.0,
         weight_decay=0.05,
+        remat=True,  # depth-12 attention stack: recompute, don't hold
         mesh=MeshSpec(data=-1),  # whole slice
     ),
 }

@@ -30,8 +30,18 @@ LossFn = Callable[..., jax.Array]
 
 
 def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
-                dropout_key, *, with_grad_norm: bool = False):
-    """The shared fwd+bwd+update body every step variant compiles."""
+                dropout_key, *, with_grad_norm: bool = False,
+                remat: bool = False):
+    """The shared fwd+bwd+update body every step variant compiles.
+
+    `remat=True` wraps the forward in `jax.checkpoint`: activations are
+    recomputed in the backward pass instead of living in HBM across it —
+    the FLOPs-for-bandwidth trade deep models need to fit a chip (e.g. ViT
+    on long token sequences). Policy: `dots_with_no_batch_dims_saveable` —
+    weight-matmul outputs are saved, while BATCHED dots (attention
+    score/value einsums, the O(S^2) terms) are recomputed; that is the
+    flash-attention-style trade this flag exists for.
+    """
     # Structural guards (SURVEY.md §5.2): trace-time only — zero runtime
     # cost under jit. The reference's analogue was graph finalization +
     # the accumulator's staleness check; in a pure program the remaining
@@ -43,10 +53,17 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
     x = batch["image"].astype(jnp.float32) / 255.0
     y = batch["label"]
 
-    def loss_of(params):
-        logits, new_model_state = model.apply(
-            params, state.model_state, x, train=True, rng=dropout_key
+    def forward(params, model_state, xb):
+        return model.apply(params, model_state, xb, train=True,
+                           rng=dropout_key)
+
+    if remat:
+        forward = jax.checkpoint(
+            forward, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
+
+    def loss_of(params):
+        logits, new_model_state = forward(params, state.model_state, x)
         return loss_fn(logits, y), (logits, new_model_state)
 
     (loss, (logits, new_model_state)), grads = jax.value_and_grad(
@@ -70,7 +87,8 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
     return new_state, out
 
 
-def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size):
+def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
+                    remat: bool = False):
     """One step with batch sampling inside the program (fused-input body)."""
 
     def one_step(state: TrainState):
@@ -79,7 +97,7 @@ def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size):
         )
         batch = device_dataset.sample(sample_key, batch_size)
         return _train_core(model, optimizer, loss_fn, state, batch,
-                           dropout_key)
+                           dropout_key, remat=remat)
 
     return one_step
 
@@ -112,6 +130,7 @@ def make_train_step(
     rules: ShardingRules = DP_RULES,
     donate: bool = True,
     with_grad_norm: bool = False,
+    remat: bool = False,
 ):
     """Build `step(state, batch) -> (state, metrics)` jitted over `mesh`.
 
@@ -125,7 +144,8 @@ def make_train_step(
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
         dropout_key = jax.random.fold_in(state.rng, state.step)
         return _train_core(model, optimizer, loss_fn, state, batch,
-                           dropout_key, with_grad_norm=with_grad_norm)
+                           dropout_key, with_grad_norm=with_grad_norm,
+                           remat=remat)
 
     return _lazy_jit(step, mesh, rules, donate, n_args=2)
 
@@ -139,6 +159,7 @@ def make_fused_train_step(
     *,
     loss_fn: LossFn = losses.softmax_cross_entropy,
     rules: ShardingRules = DP_RULES,
+    remat: bool = False,
 ):
     """`step(state) -> (state, metrics)` with BATCH SAMPLING INSIDE the
     compiled program (data/pipeline.DeviceDataset): the host does zero
@@ -147,7 +168,7 @@ def make_fused_train_step(
     bench-path step; semantics = with-replacement sampling (vs the hooked
     loop's shuffled epochs)."""
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
-                               batch_size)
+                               batch_size, remat=remat)
     return _lazy_jit(one_step, mesh, rules, donate=True)
 
 
@@ -161,6 +182,7 @@ def make_scanned_train_fn(
     *,
     loss_fn: LossFn = losses.softmax_cross_entropy,
     rules: ShardingRules = DP_RULES,
+    remat: bool = False,
 ):
     """`run(state) -> (state, metrics)` executing `chunk` fused steps in ONE
     XLA program via `lax.scan` — zero per-step Python dispatch, the
@@ -170,7 +192,7 @@ def make_scanned_train_fn(
     per-step loop; this removes that ceiling."""
 
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
-                               batch_size)
+                               batch_size, remat=remat)
 
     def run_chunk(state: TrainState):
         state, outs = jax.lax.scan(

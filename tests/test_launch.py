@@ -80,3 +80,44 @@ def test_launch_propagates_child_failure(tmp_path):
         devices_per_process=1,
     )
     assert rc != 0
+
+
+@pytest.mark.slow
+def test_two_process_checkpoint_resume(tmp_path):
+    """Collective checkpoint restore across a REAL 2-process cluster: run 1
+    saves, run 2 must log restored=True on BOTH processes and continue to
+    the extended step count (the multi-host analogue of
+    SessionManager.prepare_session auto-restore, SURVEY.md §3.5)."""
+    import contextlib
+    import io
+
+    data_dir = str(tmp_path / "data")
+    ckpt_dir = str(tmp_path / "ckpt")
+    subprocess.run(
+        [sys.executable, "-m", "dist_mnist_tpu.cli.train",
+         "--download_only", f"--data_dir={data_dir}",
+         "--config=mlp_mnist", "--platform=cpu"],
+        capture_output=True, text=True, timeout=300, check=True,
+    )
+    common = [
+        "--config=mlp_mnist", f"--data_dir={data_dir}",
+        f"--checkpoint_dir={ckpt_dir}", "--batch_size=32",
+        "--eval_every=0", "--log_every=2",
+    ]
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc1 = launch(2, common + ["--train_steps=4"], platform="cpu",
+                     devices_per_process=2)
+    log1 = buf.getvalue()
+    assert rc1 == 0, log1
+    assert re.search(r"\[p0\].*restored=False", log1), log1
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc2 = launch(2, common + ["--train_steps=8"], platform="cpu",
+                     devices_per_process=2)
+    log2 = buf.getvalue()
+    assert rc2 == 0, log2
+    for p in ("p0", "p1"):
+        assert re.search(rf"\[{p}\].*restored=True", log2), log2
+        assert re.search(rf"\[{p}\].*done: step=8", log2), log2

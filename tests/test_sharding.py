@@ -109,6 +109,11 @@ def test_hybrid_mesh_shapes():
     assert ici == (1, 2, 1, 2)
     assert dcn == (1, 1, 1, 2)
 
+    # slice factor split across BOTH DCN-tolerant axes: 4 = 2(data) x 2(pipe)
+    ici, dcn = hybrid_mesh_shapes((2, 3, 1, 2), num_slices=4)
+    assert ici == (1, 3, 1, 1)
+    assert dcn == (2, 1, 1, 2)
+
     # neither data nor pipe divisible -> None (caller warns + plain layout)
     assert hybrid_mesh_shapes((6, 1, 1, 1), num_slices=4) is None
 

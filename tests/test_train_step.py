@@ -181,3 +181,32 @@ def test_malformed_batch_rejected_at_trace_time(mesh8, small_mnist):
         with pytest.raises(AssertionError):
             step(state, {"image": imgs,
                          "label": small_mnist.train_labels[:8].astype("float32")})
+
+
+def test_remat_matches_plain(mesh8, small_mnist):
+    """jax.checkpoint must change memory, never math: one step with and
+    without remat produces identical params (same rng paths)."""
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.train import create_train_state, make_train_step
+
+    model = get_model("lenet5")
+    opt = optim.adam(1e-3)
+    batch = shard_batch(
+        {"image": small_mnist.train_images[:16],
+         "label": small_mnist.train_labels[:16]}, mesh8,
+    )
+    outs = {}
+    for name, remat in [("plain", False), ("remat", True)]:
+        with mesh8:
+            state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                       small_mnist.train_images[:1])
+            step = make_train_step(model, opt, mesh8, donate=False,
+                                   remat=remat)
+            new_state, out = step(state, batch)
+            outs[name] = (new_state, out)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+        outs["plain"][0].params, outs["remat"][0].params,
+    )
+    np.testing.assert_allclose(float(outs["plain"][1]["loss"]),
+                               float(outs["remat"][1]["loss"]), rtol=1e-6)
