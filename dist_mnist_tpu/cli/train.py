@@ -198,6 +198,7 @@ def run_config(
             )
         if profile and logdir:
             hooks.append(hooks_lib.ProfilerHook(logdir))
+            hooks.append(hooks_lib.MemoryProfileHook(logdir))
         hooks.extend(extra_hooks)
 
         # resume-aware: start the stream at the restored step so the

@@ -19,6 +19,7 @@ from dist_mnist_tpu.hooks.builtin import (
     EvalHook,
     GlobalStepWaiterHook,
     FinalOpsHook,
+    MemoryProfileHook,
 )
 
 __all__ = [
@@ -34,4 +35,5 @@ __all__ = [
     "EvalHook",
     "GlobalStepWaiterHook",
     "FinalOpsHook",
+    "MemoryProfileHook",
 ]

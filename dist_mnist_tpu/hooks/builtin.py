@@ -209,6 +209,46 @@ class ProfilerHook(Hook):
             self._active = False
 
 
+class MemoryProfileHook(Hook):
+    """Dump a device-memory profile (pprof) at a chosen step — the HBM
+    triage companion to ProfilerHook's timeline. No reference counterpart
+    (the PS design had no device-memory pressure to triage); exists because
+    OOM-at-scale is the TPU failure mode the reference never had."""
+
+    def __init__(self, logdir: str, after_steps: int = 12):
+        self._logdir = logdir
+        self._after = after_steps  # relative: fires this many steps into
+        self._at = None            # THIS run (restored runs included)
+
+    def begin(self, loop):
+        # anchor to the restored step, and never past the run's end — a
+        # short run still gets its profile on the final step
+        self._at = loop.initial_step + self._after
+
+    def after_step(self, step, state, outputs):
+        if self._at is None or step < self._at:
+            return
+        self._at = None  # fire once
+        try:
+            jax.block_until_ready(outputs.get("loss"))
+            path = f"{self._logdir}/memory-step{step}.prof"
+            jax.profiler.save_device_memory_profile(path)
+            log.info("device memory profile -> %s", path)
+        except Exception:  # noqa: BLE001 — triage aid must not kill training
+            log.exception("device memory profile failed")
+
+    def end(self, state):
+        # run shorter than after_steps: still capture (post-final-step)
+        if self._at is not None:
+            self._at = None
+            try:
+                path = f"{self._logdir}/memory-final.prof"
+                jax.profiler.save_device_memory_profile(path)
+                log.info("device memory profile -> %s", path)
+            except Exception:  # noqa: BLE001
+                log.exception("device memory profile failed")
+
+
 class GlobalStepWaiterHook(Hook):
     """≙ GlobalStepWaiterHook (basic_session_run_hooks.py:902): delay this
     process's training until the job's global step reaches `wait_until_step`.

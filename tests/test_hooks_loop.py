@@ -280,3 +280,31 @@ def test_global_step_waiter_reloads_bare_managers():
     loop = TrainLoop(_fake_step, _state(), iter([1.0]), [hook])
     loop.run()
     assert mgr.reloads == 3
+
+
+def test_memory_profile_hook(tmp_path):
+    from dist_mnist_tpu.hooks import MemoryProfileHook
+
+    hook = MemoryProfileHook(str(tmp_path), after_steps=2)
+    loop = TrainLoop(_fake_step, _state(), iter([1.0] * 3), [hook])
+    loop.run()
+    prof = tmp_path / "memory-step2.prof"
+    assert prof.exists() and prof.stat().st_size > 0
+
+
+def test_memory_profile_hook_resumed_and_short_runs(tmp_path):
+    """Anchors to the RESTORED step (fires) and still captures when the run
+    is shorter than after_steps (memory-final.prof at end)."""
+    from dist_mnist_tpu.hooks import MemoryProfileHook
+
+    hook = MemoryProfileHook(str(tmp_path), after_steps=2)
+    loop = TrainLoop(_fake_step, _state(step=100), iter([1.0] * 3), [hook])
+    loop.run()
+    assert (tmp_path / "memory-step102.prof").exists()
+
+    short = tmp_path / "short"
+    short.mkdir()
+    hook = MemoryProfileHook(str(short), after_steps=50)
+    loop = TrainLoop(_fake_step, _state(), iter([1.0] * 3), [hook])
+    loop.run()
+    assert (short / "memory-final.prof").exists()
