@@ -170,13 +170,21 @@ class SummaryHook(Hook):
 class ProfilerHook(Hook):
     """≙ ProfilerHook (:1013-1095): Chrome-trace a window of steps. Uses
     jax.profiler (XLA + ICI in one TensorBoard trace) instead of
-    RunMetadata/Timeline."""
+    RunMetadata/Timeline. `start_step`/`num_steps` are relative to THIS
+    run's first step (resume-aware)."""
 
     def __init__(self, logdir: str, start_step: int = 10, num_steps: int = 3):
         self._logdir = logdir
-        self._start = start_step
-        self._stop = start_step + num_steps
+        self._start_offset = start_step  # relative to THIS run's first step
+        self._num = num_steps
+        self._start = self._stop = None
         self._active = False
+
+    def begin(self, loop):
+        # anchor to the restored step — a run resumed at step 100 traces
+        # steps 110..112, not never
+        self._start = loop.initial_step + self._start_offset
+        self._stop = self._start + self._num
 
     def before_step(self, step):
         if step == self._start and not self._active:
@@ -215,7 +223,10 @@ class MemoryProfileHook(Hook):
     (the PS design had no device-memory pressure to triage); exists because
     OOM-at-scale is the TPU failure mode the reference never had."""
 
-    def __init__(self, logdir: str, after_steps: int = 12):
+    def __init__(self, logdir: str, after_steps: int = 20):
+        # default 20 stays clear of ProfilerHook's default trace window
+        # (steps 10..12 of the run) — the blocking dump would otherwise
+        # land mid-trace and distort the timeline it accompanies
         self._logdir = logdir
         self._after = after_steps  # relative: fires this many steps into
         self._at = None            # THIS run (restored runs included)
@@ -225,28 +236,27 @@ class MemoryProfileHook(Hook):
         # short run still gets its profile on the final step
         self._at = loop.initial_step + self._after
 
-    def after_step(self, step, state, outputs):
-        if self._at is None or step < self._at:
-            return
-        self._at = None  # fire once
+    def _dump(self, path, sync_on=None):
         try:
-            jax.block_until_ready(outputs.get("loss"))
-            path = f"{self._logdir}/memory-step{step}.prof"
+            if sync_on is not None:
+                jax.block_until_ready(sync_on)
             jax.profiler.save_device_memory_profile(path)
             log.info("device memory profile -> %s", path)
         except Exception:  # noqa: BLE001 — triage aid must not kill training
             log.exception("device memory profile failed")
 
+    def after_step(self, step, state, outputs):
+        if self._at is None or step < self._at:
+            return
+        self._at = None  # fire once
+        self._dump(f"{self._logdir}/memory-step{step}.prof",
+                   sync_on=outputs.get("loss"))
+
     def end(self, state):
         # run shorter than after_steps: still capture (post-final-step)
         if self._at is not None:
             self._at = None
-            try:
-                path = f"{self._logdir}/memory-final.prof"
-                jax.profiler.save_device_memory_profile(path)
-                log.info("device memory profile -> %s", path)
-            except Exception:  # noqa: BLE001
-                log.exception("device memory profile failed")
+            self._dump(f"{self._logdir}/memory-final.prof")
 
 
 class GlobalStepWaiterHook(Hook):

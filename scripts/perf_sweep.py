@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Perf sweep for the headline bench — run on the real chip.
+
+Times the LeNet-5 step (the BASELINE.md metric) across the knobs that
+matter, one JSON line per variant, so regressions/wins are attributable:
+
+- step dispatch: per-step fused vs lax.scan chunks of {10, 100, 500}
+- compute dtype: bfloat16 vs float32
+- input path: fused on-device sampling vs host feed (ShardedBatcher)
+- remat on/off (memory-for-FLOPs; should be ~neutral for LeNet)
+
+Usage: python scripts/perf_sweep.py [--steps 2000] [--batch 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+
+def time_variant(run_fn, state, n_chunks: int):
+    state, out = run_fn(state)  # compile + warmup
+    jax.block_until_ready(out["loss"])
+    t0 = time.monotonic()
+    for _ in range(n_chunks):
+        state, out = run_fn(state)
+    jax.block_until_ready(out["loss"])
+    return time.monotonic() - t0, state
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=2000)
+    ap.add_argument("--batch", type=int, default=200)
+    ap.add_argument("--model", default="lenet5")
+    args = ap.parse_args()
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    import jax.numpy as jnp
+
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.data import DeviceDataset, ShardedBatcher, load_dataset
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state, make_train_step
+    from dist_mnist_tpu.train.step import make_scanned_train_fn
+
+    n_chips = jax.device_count()
+    mesh = make_mesh(MeshSpec(data=-1))
+    dataset = load_dataset("mnist", "/tmp/mnist-data", seed=0)
+
+    def fresh_state(model):
+        state = create_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   dataset.train_images[:1])
+        return shard_train_state(state, mesh)
+
+    optimizer = optim.adam(1e-3)
+    results = []
+
+    with mesh:
+        dd = DeviceDataset(dataset, mesh)
+
+        # -- scan chunk size x dtype x remat --------------------------------
+        for chunk in (10, 100, 500):
+            for dtype_name in ("bfloat16", "float32"):
+                for remat in (False, True):
+                    if remat and (chunk != 100 or dtype_name != "bfloat16"):
+                        continue  # remat: one representative point
+                    model = get_model(
+                        args.model, compute_dtype=getattr(jnp, dtype_name)
+                    )
+                    run = make_scanned_train_fn(
+                        model, optimizer, mesh, dd, args.batch, chunk,
+                        remat=remat,
+                    )
+                    n_chunks = max(1, args.steps // chunk)
+                    dt, _ = time_variant(run, fresh_state(model), n_chunks)
+                    steps = n_chunks * chunk
+                    results.append({
+                        "variant": f"scan{chunk}_{dtype_name}"
+                                   + ("_remat" if remat else ""),
+                        "steps_per_sec_per_chip": round(steps / dt / n_chips, 2),
+                    })
+                    print(json.dumps(results[-1]), flush=True)
+
+        # -- host-feed path (the reference-style per-step feed) -------------
+        model = get_model(args.model)
+        step = make_train_step(model, optimizer, mesh)
+        state = fresh_state(model)
+        batches = iter(ShardedBatcher(dataset, args.batch, mesh, seed=0))
+        state, out = step(state, next(batches))
+        jax.block_until_ready(out["loss"])
+        n = min(args.steps, 500)
+        t0 = time.monotonic()
+        for _ in range(n):
+            state, out = step(state, next(batches))
+        jax.block_until_ready(out["loss"])
+        dt = time.monotonic() - t0
+        results.append({
+            "variant": "host_feed_per_step",
+            "steps_per_sec_per_chip": round(n / dt / n_chips, 2),
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    best = max(results, key=lambda r: r["steps_per_sec_per_chip"])
+    print(json.dumps({"best": best, "chips": n_chips,
+                      "global_batch": args.batch}))
+
+
+if __name__ == "__main__":
+    main()

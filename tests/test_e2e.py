@@ -68,3 +68,15 @@ def test_lenet_fashion_dp4(tmp_path):
     )
     _, final, _ = run_config(cfg, data_dir=str(tmp_path / "data"))
     assert final["accuracy"] >= 0.9
+
+
+@pytest.mark.slow
+def test_resnet20_cifar_smoke(tmp_path):
+    """Ladder config 4 builds, shards 8-way, and steps through the real
+    driver (BN state threading + cosine/clip/8-way psum all exercised)."""
+    cfg = get_config("resnet20_cifar", train_steps=3, batch_size=64,
+                     eval_every=0, log_every=1)
+    state, final, ctx = run_config(cfg, data_dir=str(tmp_path / "data"))
+    assert state.step_int == 3
+    assert np.isfinite(final["loss"])
+    assert ctx["mesh"].shape["data"] == 8
