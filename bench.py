@@ -63,7 +63,7 @@ def bench_config(name: str, n_timed: int):
         dd = DeviceDataset(dataset, mesh)
         run = make_scanned_train_fn(model, optimizer, mesh, dd,
                                     cfg.batch_size, chunk, loss_fn=loss_fn,
-                                    remat=cfg.remat)
+                                    remat=cfg.remat, augment=cfg.augment)
         state, out = run(state)  # compile + warmup
         jax.block_until_ready(out["loss"])
         t0 = time.monotonic()

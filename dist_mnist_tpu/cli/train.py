@@ -169,7 +169,7 @@ def run_config(
         )
 
         step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn,
-                                  remat=cfg.remat)
+                                  remat=cfg.remat, augment=cfg.augment)
         eval_step = make_eval_step(model, mesh)
         eval_fn = lambda s: evaluate(
             eval_step, s, dataset.test_images, dataset.test_labels, mesh

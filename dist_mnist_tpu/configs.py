@@ -33,6 +33,7 @@ class Config:
     grad_clip_norm: float | None = None
     weight_decay: float = 0.0
     remat: bool = False  # jax.checkpoint the forward (HBM <-> FLOPs trade)
+    augment: bool = False  # on-device pad-crop-flip (data/augment.py)
     eval_every: int = 1000
     log_every: int = 100
     checkpoint_every_secs: float = 600.0  # CheckpointSaverHook default cadence
@@ -83,6 +84,7 @@ CONFIGS = {
         lr_schedule="cosine",
         warmup_steps=200,
         grad_clip_norm=1.0,
+        augment=True,  # pad-crop-flip: standard CIFAR recipe, on device
         mesh=MeshSpec(data=8),
     ),
     # 5) ViT-Tiny / CIFAR-10 / pod slice (stretch; attention path)
@@ -98,6 +100,7 @@ CONFIGS = {
         grad_clip_norm=1.0,
         weight_decay=0.05,
         remat=True,  # depth-12 attention stack: recompute, don't hold
+        augment=True,
         mesh=MeshSpec(data=-1),  # whole slice
     ),
 }

@@ -1,0 +1,48 @@
+"""On-device data augmentation, fused into the compiled step.
+
+The reference had no augmentation (MNIST feed_dict of raw pixels); the
+CIFAR rungs of the ladder (BASELINE.md configs 4-5) need the standard
+pad-crop-flip recipe to reach competitive accuracy. TPU-native design:
+augmentation is pure jax on the ALREADY-SHARDED uint8 batch inside jit —
+each device augments only its slice, the host does nothing, and XLA fuses
+the gather/select chain into the input pipeline of the first conv.
+
+All ops are static-shape (pad + dynamic_slice via per-example gather
+indices) — no data-dependent shapes, scan/vmap-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop_flip(
+    key: jax.Array,
+    images: jax.Array,
+    *,
+    pad: int = 4,
+    flip: bool = True,
+) -> jax.Array:
+    """Pad-reflect by `pad`, random-crop back to HxW, random horizontal
+    flip. [B,H,W,C] any dtype -> same shape/dtype.
+
+    Index-arithmetic formulation instead of per-example dynamic_slice:
+    crops become one fused gather, which XLA tiles well on TPU (a vmapped
+    dynamic_slice would lower to B scalar-offset slices).
+    """
+    b, h, w, c = images.shape
+    k_crop, k_flip = jax.random.split(key)
+    padded = jnp.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+    )
+    # per-example crop origins in [0, 2*pad]
+    oy, ox = jax.random.randint(k_crop, (2, b), 0, 2 * pad + 1)
+    rows = oy[:, None] + jnp.arange(h)[None, :]  # [B,H]
+    cols = ox[:, None] + jnp.arange(w)[None, :]  # [B,W]
+    out = padded[jnp.arange(b)[:, None, None], rows[:, :, None],
+                 cols[:, None, :], :]
+    if flip:
+        do = jax.random.bernoulli(k_flip, 0.5, (b,))
+        out = jnp.where(do[:, None, None, None], out[:, :, ::-1, :], out)
+    return out

@@ -31,7 +31,7 @@ LossFn = Callable[..., jax.Array]
 
 def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
                 dropout_key, *, with_grad_norm: bool = False,
-                remat: bool = False):
+                remat: bool = False, augment: bool = False):
     """The shared fwd+bwd+update body every step variant compiles.
 
     `remat=True` wraps the forward in `jax.checkpoint`: activations are
@@ -50,7 +50,15 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
     chex.assert_rank(batch["label"], 1)
     chex.assert_type(batch["label"], int)
     chex.assert_equal_shape_prefix([batch["image"], batch["label"]], 1)
-    x = batch["image"].astype(jnp.float32) / 255.0
+    img = batch["image"]
+    if augment:
+        # on the sharded uint8 batch, inside jit: each device augments its
+        # own slice, zero host work (data/augment.py)
+        from dist_mnist_tpu.data.augment import random_crop_flip
+
+        aug_key, dropout_key = jax.random.split(dropout_key)
+        img = random_crop_flip(aug_key, img)
+    x = img.astype(jnp.float32) / 255.0
     y = batch["label"]
 
     def forward(params, model_state, xb):
@@ -88,7 +96,7 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
 
 
 def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
-                    remat: bool = False):
+                    remat: bool = False, augment: bool = False):
     """One step with batch sampling inside the program (fused-input body)."""
 
     def one_step(state: TrainState):
@@ -97,7 +105,7 @@ def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
         )
         batch = device_dataset.sample(sample_key, batch_size)
         return _train_core(model, optimizer, loss_fn, state, batch,
-                           dropout_key, remat=remat)
+                           dropout_key, remat=remat, augment=augment)
 
     return one_step
 
@@ -131,6 +139,7 @@ def make_train_step(
     donate: bool = True,
     with_grad_norm: bool = False,
     remat: bool = False,
+    augment: bool = False,
 ):
     """Build `step(state, batch) -> (state, metrics)` jitted over `mesh`.
 
@@ -145,7 +154,7 @@ def make_train_step(
         dropout_key = jax.random.fold_in(state.rng, state.step)
         return _train_core(model, optimizer, loss_fn, state, batch,
                            dropout_key, with_grad_norm=with_grad_norm,
-                           remat=remat)
+                           remat=remat, augment=augment)
 
     return _lazy_jit(step, mesh, rules, donate, n_args=2)
 
@@ -160,6 +169,7 @@ def make_fused_train_step(
     loss_fn: LossFn = losses.softmax_cross_entropy,
     rules: ShardingRules = DP_RULES,
     remat: bool = False,
+    augment: bool = False,
 ):
     """`step(state) -> (state, metrics)` with BATCH SAMPLING INSIDE the
     compiled program (data/pipeline.DeviceDataset): the host does zero
@@ -168,7 +178,7 @@ def make_fused_train_step(
     bench-path step; semantics = with-replacement sampling (vs the hooked
     loop's shuffled epochs)."""
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
-                               batch_size, remat=remat)
+                               batch_size, remat=remat, augment=augment)
     return _lazy_jit(one_step, mesh, rules, donate=True)
 
 
@@ -183,6 +193,7 @@ def make_scanned_train_fn(
     loss_fn: LossFn = losses.softmax_cross_entropy,
     rules: ShardingRules = DP_RULES,
     remat: bool = False,
+    augment: bool = False,
 ):
     """`run(state) -> (state, metrics)` executing `chunk` fused steps in ONE
     XLA program via `lax.scan` — zero per-step Python dispatch, the
@@ -192,7 +203,7 @@ def make_scanned_train_fn(
     per-step loop; this removes that ceiling."""
 
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
-                               batch_size, remat=remat)
+                               batch_size, remat=remat, augment=augment)
 
     def run_chunk(state: TrainState):
         state, outs = jax.lax.scan(
