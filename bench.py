@@ -1,43 +1,136 @@
 #!/usr/bin/env python
-"""Benchmark driver — prints ONE JSON line for the harness.
+"""Benchmark driver — prints ONE JSON line for the harness, ALWAYS.
 
 Headline metric (BASELINE.md): LeNet-5 (the "MNIST CNN") steps/sec/chip at
 the reference's original dist-config geometry (global batch 200 = 2 workers
-x 100 — SURVEY.md §0.1). The run uses the fused-input step
-(train/step.make_fused_train_step): dataset resident in HBM, batch sampling
-compiled into the step, zero host work per step — the polar opposite of the
-reference's per-step feed_dict -> gRPC -> PS round-trip (§3.3).
+x 100 — SURVEY.md §0.1), plus MFU (XLA-counted step FLOPs ÷ step time ÷
+chip bf16 peak, utils/flops.py) — the honest cross-dataset utilization
+number. The run uses the scanned fused-input step: dataset resident in HBM,
+batch sampling compiled into the step, zero host work per step — the polar
+opposite of the reference's per-step feed_dict -> gRPC -> PS round-trip
+(§3.3).
 
-`vs_baseline`: the reference publishes no steps/sec numbers
-(BASELINE.json `published: {}`), so the only authoritative target is the
-north star "≥99% MNIST test accuracy in <60 s wall-clock". We time the
-accuracy race (training start -> first eval ≥99%, compile included) and
-report vs_baseline = 60s / wall_to_99 (>1 = beating the target).
+Provenance: this box has no egress, so when real MNIST IDX files are absent
+the data is the procedural synthetic twin (data/synthetic.py) — EASIER than
+real MNIST. `synthetic_data` is reported at TOP level, and the ≥99%-in-<60s
+north-star race (`vs_baseline` = 60s / wall_to_99) is only scored when the
+data is real; on synthetic data the race result is still measured but
+reported under `extra.accuracy_race` with vs_baseline pinned to 0.0
+(= "no valid baseline comparison").
+
+Robustness: the TPU tunnel in this environment can be down. Backend init is
+probed in a BOUNDED subprocess with retries, the whole run sits under a
+SIGALRM deadline, and every failure path still prints a structured JSON
+line — `BENCH_r*.json.parsed` can never be null again (VERDICT r2 item 1).
 
 Ladder mode (`python bench.py --config resnet20_cifar [--steps N]`) times
-any BASELINE.md config's steady-state steps/sec/chip with the same fused
-machinery — the default invocation (what the driver runs) is unchanged.
+any BASELINE.md config's steady-state steps/sec/chip + MFU on the config's
+own mesh when this box has enough chips (single-chip fallback is labeled).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
-import jax
+HEADLINE_METRIC = "lenet5_mnist_steps_per_sec_per_chip"
 
 
-def bench_config(name: str, n_timed: int):
-    """Steady-state throughput for one ladder config (no accuracy race —
-    only the headline MNIST config has a published accuracy target).
+def emit(obj) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def emit_error(metric: str, message: str, **extra) -> None:
+    """Structured failure line: parseable, value 0, error field populated."""
+    emit({
+        "metric": metric,
+        "value": 0.0,
+        "unit": "steps/sec/chip",
+        "vs_baseline": 0.0,
+        "error": message,
+        "extra": extra,
+    })
+
+
+def probe_backend(metric: str, retries: int = 3, timeout_s: int = 150) -> bool:
+    """Bounded out-of-process backend probe. A hung/down TPU tunnel makes
+    `import jax; jax.devices()` block or die IN-PROCESS — exactly what
+    produced round 1's unparseable bench. Probing in a subprocess bounds
+    the blast radius; retries cover transient tunnel restarts."""
+    errs = []
+    for attempt in range(retries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('DEVCOUNT', jax.device_count())"],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if out.returncode == 0 and "DEVCOUNT" in out.stdout:
+                return True
+            errs.append(f"rc={out.returncode}: {out.stderr.strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            errs.append(f"probe timed out after {timeout_s}s")
+        if attempt < retries - 1:
+            time.sleep(min(30, 5 * 2 ** attempt))
+    emit_error(metric, "backend probe failed after "
+               f"{retries} attempts: {errs[-1]}", probe_errors=errs)
+    return False
+
+
+def install_deadline(metric: str, seconds: int) -> None:
+    """SIGALRM watchdog: if the run wedges (backend hang mid-run), print a
+    structured line and exit 0 before the driver's own timeout hits."""
+
+    def on_alarm(signum, frame):
+        emit_error(metric, f"bench deadline ({seconds}s) exceeded — "
+                   "backend hang or pathological compile")
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+
+
+def _mfu_fields(run, state, dt_per_step: float):
+    """MFU block from the compiled step's XLA cost analysis. XLA counts a
+    scan body once (utils/flops.py), so `step_flops` of the scanned chunk
+    is already the per-step figure."""
+    import jax
+
+    from dist_mnist_tpu.utils.flops import device_peak_flops, mfu, step_flops
+
+    flops_step = step_flops(run, state)
+    util = mfu(flops_step, dt_per_step)
+    return {
+        "mfu": round(util, 4) if util is not None else None,
+        "flops_per_step": round(flops_step) if flops_step else None,
+        "model_tflops_per_sec": (
+            round(flops_step / dt_per_step / 1e12, 2) if flops_step else None
+        ),
+        "device_kind": jax.devices()[0].device_kind,
+        "peak_bf16_tflops": (
+            device_peak_flops() / 1e12 if device_peak_flops() else None
+        ),
+    }
+
+
+def bench_config(name: str, n_timed: int) -> int:
+    """Steady-state throughput + MFU for one ladder config (no accuracy
+    race — only the headline MNIST config has a published accuracy target).
 
     Times the config's REAL training step: optimizer pipeline (schedule,
     clipping, weight decay, accumulation) via cli.train.build_optimizer and
-    the config's loss — not a simplified stand-in."""
+    the config's loss — not a simplified stand-in. Runs on the config's own
+    mesh (`cfg.mesh`) when this box has the chips; otherwise falls back to
+    all visible devices and says so."""
+    import jax
+
     from dist_mnist_tpu.cli.train import build_optimizer
-    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
     from dist_mnist_tpu.configs import get_config
     from dist_mnist_tpu.data import DeviceDataset, load_dataset
     from dist_mnist_tpu.models import get_model
@@ -47,15 +140,21 @@ def bench_config(name: str, n_timed: int):
     from dist_mnist_tpu.train.step import make_scanned_train_fn
 
     cfg = get_config(name)
-    n_chips = jax.device_count()
-    mesh = make_mesh(MeshSpec(data=-1))  # whatever this box has
+    try:
+        mesh = make_mesh(cfg.mesh)  # the config's declared topology
+        mesh_note = "config"
+    except ValueError:
+        # e.g. an 8-way config on this 1-chip box: run on what exists
+        mesh = make_mesh(MeshSpec(data=-1))
+        mesh_note = f"fallback (config wants {cfg.mesh}, have {jax.device_count()})"
+    n_chips = mesh.devices.size
     dataset = load_dataset(cfg.dataset, "/tmp/mnist-data", seed=cfg.seed)
     model = get_model(cfg.model, **cfg.model_kwargs)
     optimizer = build_optimizer(cfg)
     loss_fn = (losses.clipped_softmax_cross_entropy if cfg.loss == "clipped"
                else losses.softmax_cross_entropy)
     chunk = 100
-    with mesh:
+    with activate(mesh):
         state = create_train_state(
             model, optimizer, jax.random.PRNGKey(0), dataset.train_images[:1]
         )
@@ -71,25 +170,31 @@ def bench_config(name: str, n_timed: int):
             state, out = run(state)
         jax.block_until_ready(out["loss"])
         dt = time.monotonic() - t0
-    rate = max(1, n_timed // chunk) * chunk / dt / n_chips
-    print(json.dumps({
+        n_steps = max(1, n_timed // chunk) * chunk
+        rate = n_steps / dt / n_chips
+        mfu_block = _mfu_fields(run, state, dt / n_steps)
+    emit({
         "metric": f"{name}_steps_per_sec_per_chip",
         "value": round(rate, 2),
         "unit": "steps/sec/chip",
         "vs_baseline": 0.0,  # no published reference numbers (BASELINE.md)
+        "synthetic_data": dataset.synthetic,
         "extra": {
             "chips": n_chips,
+            "mesh": mesh_note,
             "global_batch": cfg.batch_size,
             "examples_per_sec": round(rate * n_chips * cfg.batch_size),
-            "synthetic_data": dataset.synthetic,
+            **mfu_block,
         },
-    }))
+    })
     return 0
 
 
-def main():
+def main() -> int:
+    import jax
+
     from dist_mnist_tpu import optim
-    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
     from dist_mnist_tpu.data import DeviceDataset, load_dataset
     from dist_mnist_tpu.models import get_model
     from dist_mnist_tpu.parallel.sharding import shard_train_state
@@ -104,7 +209,7 @@ def main():
     batch = 200  # reference dist config: 2 workers x batch 100
 
     t_start = time.monotonic()
-    with mesh:
+    with activate(mesh):
         state = create_train_state(
             model, optimizer, jax.random.PRNGKey(0), dataset.train_images[:1]
         )
@@ -136,42 +241,72 @@ def main():
             state, out = run(state)
         jax.block_until_ready(out["loss"])
         dt = time.monotonic() - t0
+        mfu_block = _mfu_fields(run, state, dt / n_timed)
 
     steps_per_sec_per_chip = n_timed / dt / n_chips
-    result = {
-        "metric": "lenet5_mnist_steps_per_sec_per_chip",
+    synthetic = bool(dataset.synthetic)
+    # the ≥99%-in-<60s north star (BASELINE.json) is a REAL-MNIST target;
+    # the synthetic twin is easier, so a synthetic race win scores 0.0 here
+    # and is reported, labeled, under extra.accuracy_race
+    vs_baseline = (
+        round(60.0 / wall_to_99, 2) if (wall_to_99 and not synthetic) else 0.0
+    )
+    emit({
+        "metric": HEADLINE_METRIC,
         "value": round(steps_per_sec_per_chip, 2),
         "unit": "steps/sec/chip",
-        # >1.0 = beat the ≥99%-in-<60s north star; reference publishes no
-        # throughput numbers (BASELINE.json published={})
-        "vs_baseline": round(60.0 / wall_to_99, 2) if wall_to_99 else 0.0,
+        "vs_baseline": vs_baseline,
+        "synthetic_data": synthetic,
         "extra": {
             "chips": n_chips,
             "global_batch": batch,
             "examples_per_sec": round(steps_per_sec_per_chip * n_chips * batch),
-            "wall_to_99pct_acc_secs": round(wall_to_99, 2) if wall_to_99 else None,
-            "final_test_acc": round(res["accuracy"], 4),
-            "synthetic_data": dataset.synthetic,
+            **mfu_block,
+            "accuracy_race": {
+                "target": ">=99% test acc in <60s (north star; REAL MNIST)",
+                "provenance": (
+                    "synthetic procedural twin — easier than real MNIST; "
+                    "NOT a north-star result" if synthetic else "real MNIST"
+                ),
+                "wall_to_99pct_acc_secs": (
+                    round(wall_to_99, 2) if wall_to_99 else None
+                ),
+                "final_test_acc": round(res["accuracy"], 4),
+            },
         },
-    }
-    print(json.dumps(result))
+    })
     return 0
 
 
 if __name__ == "__main__":
-    # persistent XLA compile cache for BOTH modes: repeat invocations skip
-    # the ~45 s of scan/init/eval compiles entirely (cold-compile time still
-    # counts against wall_to_99 on the first run — reported honestly)
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=None,
                     help="ladder config to time (default: headline LeNet-5 "
                          "accuracy race + throughput)")
     ap.add_argument("--steps", type=int, default=500,
                     help="timed steps in --config mode")
+    ap.add_argument("--deadline", type=int, default=1500,
+                    help="hard wall-clock bound; a structured JSON error "
+                         "line is printed if exceeded")
     args = ap.parse_args()
-    if args.config:
-        sys.exit(bench_config(args.config, args.steps))
-    sys.exit(main())
+    metric = (f"{args.config}_steps_per_sec_per_chip" if args.config
+              else HEADLINE_METRIC)
+
+    install_deadline(metric, args.deadline)
+    if not probe_backend(metric):
+        sys.exit(0)  # structured error line already printed
+
+    # persistent XLA compile cache for BOTH modes: repeat invocations skip
+    # the ~45 s of scan/init/eval compiles entirely (cold-compile time still
+    # counts against wall_to_99 on the first run — reported honestly)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    try:
+        sys.exit(bench_config(args.config, args.steps) if args.config
+                 else main())
+    except Exception as e:  # noqa: BLE001 — the contract is ONE JSON line, always
+        emit_error(metric, f"{type(e).__name__}: {e}")
+        sys.exit(0)

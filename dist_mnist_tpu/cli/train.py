@@ -56,6 +56,9 @@ flags.DEFINE_string("coordinator_address", None, "host:port of process 0")
 flags.DEFINE_string("platform", None,
                     "pin the jax backend (e.g. cpu for the simulated "
                     "cluster — see cli/launch.py); None = host default")
+flags.DEFINE_integer("host_device_count", None,
+                     "with --platform=cpu: number of virtual host devices "
+                     "(multi-device configs without a pod)")
 flags.DEFINE_integer("num_processes", 1, "total processes (multi-host)")
 flags.DEFINE_integer("process_id", 0, "this process's index")
 flags.DEFINE_boolean("profile", False, "trace a window of steps to logdir")
@@ -128,6 +131,7 @@ def run_config(
     from dist_mnist_tpu import hooks as hooks_lib
     from dist_mnist_tpu.checkpoint import CheckpointManager
     from dist_mnist_tpu.cluster import make_mesh, is_chief
+    from dist_mnist_tpu.cluster.mesh import activate
     from dist_mnist_tpu.data import load_dataset, ShardedBatcher
     from dist_mnist_tpu.models import get_model
     from dist_mnist_tpu.obs import make_default_writer
@@ -154,7 +158,9 @@ def run_config(
 
     rng = jax.random.PRNGKey(cfg.seed)
     sample = dataset.train_images[:1]
-    with mesh:
+    # activate (not plain `with mesh:`) so mesh-adaptive attention
+    # (ring/ulysses discover the seq axis via the ABSTRACT mesh) engages
+    with activate(mesh):
         state = create_train_state(model, optimizer, rng, sample)
         state = shard_train_state(state, mesh)
 
@@ -294,7 +300,7 @@ def main(argv):
 
     initialize_distributed(
         FLAGS.coordinator_address, FLAGS.num_processes, FLAGS.process_id,
-        platform=FLAGS.platform,
+        platform=FLAGS.platform, host_device_count=FLAGS.host_device_count,
     )
     cfg = _apply_flag_overrides(get_config(FLAGS.config))
     if FLAGS.download_only:

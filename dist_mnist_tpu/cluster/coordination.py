@@ -38,6 +38,7 @@ def initialize_distributed(
     process_id: int | None = None,
     *,
     platform: str | None = None,
+    host_device_count: int | None = None,
 ) -> None:
     """Connect this process to the cluster (no-op single-process).
 
@@ -58,6 +59,18 @@ def initialize_distributed(
         return
     if platform:
         force_platform(platform)
+    if host_device_count:
+        if platform in (None, "cpu"):
+            # N virtual host devices in THIS process (multi-device configs
+            # on the CPU backend without the launcher, e.g.
+            # `--platform=cpu --host_device_count=8`); must precede backend
+            # init. Only the cpu backend reads this setting.
+            jax.config.update("jax_num_cpu_devices", host_device_count)
+        else:
+            log.warning(
+                "--host_device_count only applies to the cpu backend; "
+                "ignored for platform=%s", platform,
+            )
     if coordinator_address is None and (num_processes is None or num_processes <= 1):
         log.info("single-process run; skipping jax.distributed.initialize")
         _initialized = True

@@ -92,6 +92,49 @@ def slice_count(devices: Sequence[jax.Device]) -> int:
     return 1 if None in idx else max(len(idx), 1)
 
 
+class _SliceFacade:
+    """Proxy device carrying a synthetic ``slice_index`` over a real device.
+
+    Lets the multislice (DCN) layout path run on hardware that has no
+    slices: `mesh_utils.create_hybrid_device_mesh` only reads attributes
+    (`slice_index` to group granules, `platform`/`device_kind` for layout),
+    so a facade is indistinguishable from a multislice device during layout.
+    `make_mesh` unwraps facades before building the Mesh, so the resulting
+    mesh executes on the real underlying devices.
+    """
+
+    __slots__ = ("_device", "slice_index")
+
+    def __init__(self, device, slice_index: int):
+        object.__setattr__(self, "_device", device)
+        object.__setattr__(self, "slice_index", slice_index)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_device"), name)
+
+    def __repr__(self):
+        return f"SliceFacade(slice={self.slice_index}, {self._device!r})"
+
+
+def with_fake_slices(devices: Sequence[jax.Device], n_slices: int) -> list:
+    """Tag `devices` with synthetic slice indices (contiguous blocks) so
+    `make_mesh` takes the hybrid ICI×DCN branch without multislice hardware.
+    The driver's `dryrun_multichip` and the multislice tests use this to
+    execute `create_hybrid_device_mesh` placements on CPU devices."""
+    devices = list(devices)
+    if n_slices < 1 or len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_slices} slices"
+        )
+    per = len(devices) // n_slices
+    return [_SliceFacade(d, i // per) for i, d in enumerate(devices)]
+
+
+def _unwrap_facades(dev_array: np.ndarray) -> np.ndarray:
+    unwrap = lambda d: d._device if isinstance(d, _SliceFacade) else d
+    return np.vectorize(unwrap, otypes=[object])(dev_array)
+
+
 def hybrid_mesh_shapes(
     shape: tuple[int, int, int, int], num_slices: int
 ) -> tuple[tuple[int, int, int, int], tuple[int, int, int, int]] | None:
@@ -173,17 +216,23 @@ def make_mesh(
             )
         else:
             dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
-    except Exception:  # non-TPU backends can reject topology-aware layout
-        if n_slices > 1:
-            # a naive order on real multislice silently puts latency-
-            # critical axes on DCN — never do that without saying so
-            log.warning(
-                "topology-aware mesh layout failed on a %d-slice topology; "
-                "falling back to enumeration order — per-step collectives "
-                "may cross DCN", n_slices, exc_info=True,
-            )
+    except (ValueError, NotImplementedError, RuntimeError, AssertionError) as exc:
+        # AssertionError included: mesh_utils' TPU physical-topology walk
+        # asserts cuboid/contiguous device sets, which a devices[:want]
+        # prefix subset (the supported "4-way config on an 8-device host"
+        # case) can violate
+        # topology-aware layout can reject unusual shapes/backends; the
+        # enumeration-order fallback is correct but may be slow (wrong axes
+        # on the slow links) — never take it silently
+        log.warning(
+            "topology-aware mesh layout failed (%s); falling back to "
+            "enumeration order%s",
+            exc,
+            " — MULTISLICE topology: per-step collectives may cross DCN"
+            if n_slices > 1 else "",
+        )
         dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, axis_names=axis_names)
+    return Mesh(_unwrap_facades(dev_array), axis_names=axis_names)
 
 
 def local_batch_slice(global_batch: int, mesh: Mesh) -> tuple[int, int]:

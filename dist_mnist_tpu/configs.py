@@ -103,6 +103,26 @@ CONFIGS = {
         augment=True,
         mesh=MeshSpec(data=-1),  # whole slice
     ),
+    # 5b) config 5 with Ulysses sequence parallelism (SURVEY.md §5.7): the
+    # all-to-all SP alternative to ring attention, selectable like any
+    # other config. heads=4 (not ViT-Ti's 3) so heads % seq == 0, and mean
+    # pooling keeps the token count divisible by the seq axis.
+    "vit_tiny_cifar_ulysses": Config(
+        name="vit_tiny_cifar_ulysses",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"attention_impl": "ulysses", "pool": "mean", "heads": 4},
+        mesh=MeshSpec(data=-1, seq=2),
+    ),
 }
 
 

@@ -87,7 +87,8 @@ class LoggingHook(Hook):
             return
         self._timer.mark()
         keys = self._keys or outputs.keys()
-        parts = [f"{k}={float(outputs[k]):.4f}" for k in keys if k in outputs]
+        parts = [f"{k}={float(outputs[k]):.4f}" for k in keys
+                 if k in outputs and getattr(outputs[k], "size", 1) == 1]
         log.info("step %d: %s", step, ", ".join(parts))
 
 
@@ -146,22 +147,50 @@ class CheckpointHook(Hook):
 
 
 class SummaryHook(Hook):
-    """≙ SummarySaverHook (:793) + SummaryWriterCache: periodic scalar
-    summaries to a metric writer (obs/writers.py)."""
+    """≙ SummarySaverHook (:793) + SummaryWriterCache: periodic summaries to
+    a metric writer (obs/writers.py). Scalar outputs become scalar
+    summaries; array outputs (e.g. the per-leaf `grad_norms` vector from
+    `make_train_step(with_grad_norm=True)`) become histograms — the
+    arbitrary-summary-proto parity the reference hook had beyond scalars.
 
-    def __init__(self, writer, every_steps: int = 100):
+    `param_histograms_every` additionally writes one histogram per PARAM
+    LEAF on its own (slower) cadence — it pulls every param to the host, so
+    it defaults off and should stay a few orders sparser than scalars.
+    """
+
+    def __init__(self, writer, every_steps: int = 100,
+                 param_histograms_every: int | None = None):
         self._writer = writer
         self._timer = EverySteps(every_steps=every_steps)
+        self._param_timer = (
+            EverySteps(every_steps=param_histograms_every)
+            if param_histograms_every else None
+        )
 
     def after_step(self, step, state, outputs):
+        if self._param_timer and self._param_timer.should_trigger(step):
+            self._param_timer.mark()
+            self._write_param_histograms(step, state)
         if not self._timer.should_trigger(step):
             return
         self._timer.mark()
         for k, v in outputs.items():
+            if getattr(v, "size", 1) > 1:
+                self._writer.histogram(k, jax.device_get(v), step)
+                continue
             try:
                 self._writer.scalar(k, float(v), step)
             except (TypeError, ValueError):
                 pass
+
+    def _write_param_histograms(self, step, state):
+        from dist_mnist_tpu.parallel.sharding import _paths
+
+        flat, _, paths = _paths(state.params)
+        for path, (_, leaf) in zip(paths, flat):
+            if getattr(leaf, "size", 0):
+                self._writer.histogram(f"params/{path}",
+                                       jax.device_get(leaf), step)
 
     def end(self, state):
         self._writer.flush()
@@ -191,30 +220,34 @@ class ProfilerHook(Hook):
             jax.profiler.start_trace(self._logdir)
             self._active = True
 
+    def _stop_and_export(self):
+        jax.profiler.stop_trace()
+        self._active = False
+        log.info("profile (window [%d, %d)) -> %s",
+                 self._start, self._stop, self._logdir)
+        try:
+            # reference UX parity: a chrome://tracing-loadable
+            # timeline-*.json next to the profile (obs/timeline.py)
+            from dist_mnist_tpu.obs.timeline import export_chrome_trace
+
+            out = export_chrome_trace(self._logdir)
+            if out is not None:
+                log.info("chrome trace -> %s", out)
+        except Exception:  # noqa: BLE001 — triage aid must not kill training
+            log.exception("chrome trace export failed")
+
     def after_step(self, step, state, outputs):
         # after_step sees the post-increment step: steps _start.._stop-1
         # (num_steps of them) run inside the trace window
         if self._active and step >= self._stop:
             jax.block_until_ready(outputs.get("loss"))
-            jax.profiler.stop_trace()
-            self._active = False
-            log.info("profile for steps [%d, %d) -> %s",
-                     self._start, self._stop, self._logdir)
-            try:
-                # reference UX parity: a chrome://tracing-loadable
-                # timeline-*.json next to the profile (obs/timeline.py)
-                from dist_mnist_tpu.obs.timeline import export_chrome_trace
-
-                out = export_chrome_trace(self._logdir)
-                if out is not None:
-                    log.info("chrome trace -> %s", out)
-            except Exception:  # noqa: BLE001 — triage aid must not kill training
-                log.exception("chrome trace export failed")
+            self._stop_and_export()
 
     def end(self, state):
+        # a run shorter than the trace window still gets its timeline —
+        # same export path as the cadence stop (ADVICE r1 item 1)
         if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+            self._stop_and_export()
 
 
 class MemoryProfileHook(Hook):

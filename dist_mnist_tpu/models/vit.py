@@ -5,8 +5,10 @@ Standard ViT-Ti geometry (dim 192, depth 12, heads 3), 4x4 patches so a
 32x32 image is a 64-token sequence, learned position embeddings, CLS token,
 pre-LN blocks. The attention inner loop is swappable: the default XLA
 einsum path (ops/nn.dot_product_attention), the Pallas flash kernel
-(ops/pallas/flash_attention.py), or ring attention over the `seq` mesh axis
-(parallel/ring_attention.py) — selected by `attention_impl`.
+(ops/pallas/flash_attention.py), ring attention over the `seq` mesh axis
+(parallel/ring_attention.py), or Ulysses all-to-all sequence parallelism
+(parallel/ulysses.py; needs heads % seq == 0) — selected by
+`attention_impl`.
 """
 
 from __future__ import annotations
@@ -29,7 +31,7 @@ class ViTTiny:
     mlp_ratio: int = 4
     dropout_rate: float = 0.1
     compute_dtype: jnp.dtype = jnp.bfloat16
-    attention_impl: str = "xla"  # "xla" | "flash" | "ring"
+    attention_impl: str = "xla"  # "xla" | "flash" | "ring" | "ulysses"
     pool: str = "cls"  # "cls" | "mean" (mean keeps token count a power of
     # two — required when the sequence dim is sharded, e.g. ring attention)
 
@@ -78,10 +80,14 @@ class ViTTiny:
             from dist_mnist_tpu.parallel.ring_attention import ring_attention
 
             out = ring_attention(q, k, v)
+        elif self.attention_impl == "ulysses":
+            from dist_mnist_tpu.parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(q, k, v)
         else:
             raise ValueError(
                 f"unknown attention_impl {self.attention_impl!r}; "
-                "use 'xla' | 'flash' | 'ring'"
+                "use 'xla' | 'flash' | 'ring' | 'ulysses'"
             )
         return nn.dense(p["out"], out.reshape(b, s, d))
 

@@ -14,25 +14,49 @@ import logging
 from pathlib import Path
 from typing import Protocol
 
+import numpy as np
+
 log = logging.getLogger(__name__)
 
 
 class MetricWriter(Protocol):
     def scalar(self, tag: str, value: float, step: int) -> None: ...
 
+    def histogram(self, tag: str, values, step: int) -> None: ...
+
     def flush(self) -> None: ...
+
+
+def _summary_stats(values) -> dict[str, float]:
+    v = np.asarray(values, dtype=np.float64).ravel()
+    if v.size == 0:
+        return {"count": 0.0}
+    return {
+        "count": float(v.size),
+        "mean": float(v.mean()),
+        "std": float(v.std()),
+        "min": float(v.min()),
+        "max": float(v.max()),
+    }
 
 
 class StdoutWriter:
     def scalar(self, tag, value, step):
         log.info("[metric] step=%d %s=%.6g", step, tag, value)
 
+    def histogram(self, tag, values, step):
+        s = _summary_stats(values)
+        log.info("[hist] step=%d %s: %s", step, tag,
+                 " ".join(f"{k}={v:.6g}" for k, v in s.items()))
+
     def flush(self):
         pass
 
 
 class CsvWriter:
-    """One CSV per run: step,tag,value — trivially parseable by benches."""
+    """One CSV per run: step,tag,value — trivially parseable by benches.
+    A CSV is a scalar sink, so histograms land as summary-stat rows
+    (`tag/mean`, `tag/std`, ...)."""
 
     def __init__(self, path: str | Path):
         self.path = Path(path)
@@ -44,6 +68,10 @@ class CsvWriter:
 
     def scalar(self, tag, value, step):
         self._writer.writerow([step, tag, value])
+
+    def histogram(self, tag, values, step):
+        for k, v in _summary_stats(values).items():
+            self._writer.writerow([step, f"{tag}/{k}", v])
 
     def flush(self):
         self._fh.flush()
@@ -66,6 +94,12 @@ class TensorBoardWriter:
         if self._w is not None:
             self._w.write_scalars(step, {tag: value})
 
+    def histogram(self, tag, values, step):
+        # full-distribution summaries — the reference's arbitrary-proto
+        # summary path ($TF basic_session_run_hooks.py:793) beyond scalars
+        if self._w is not None:
+            self._w.write_histograms(step, {tag: np.asarray(values).ravel()})
+
     def flush(self):
         if self._w is not None:
             self._w.flush()
@@ -78,6 +112,10 @@ class MultiWriter:
     def scalar(self, tag, value, step):
         for w in self.writers:
             w.scalar(tag, value, step)
+
+    def histogram(self, tag, values, step):
+        for w in self.writers:
+            w.histogram(tag, values, step)
 
     def flush(self):
         for w in self.writers:

@@ -15,7 +15,7 @@ from functools import partial
 
 import jax
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P, get_abstract_mesh
 
 from dist_mnist_tpu.cluster.mesh import SEQ_AXIS
 from dist_mnist_tpu.ops.nn import dot_product_attention
@@ -47,3 +47,14 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v):
+    """Mesh-adaptive entry used by models (mirrors ring_attention): the
+    all-to-all reshard runs over the ambient mesh's `seq` axis when present
+    (>1), else falls back to exact local attention — the same model code
+    runs on any mesh. Requires H % seq == 0 and S % seq == 0 on seq meshes."""
+    mesh = get_abstract_mesh()
+    if mesh is None or SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] == 1:
+        return dot_product_attention(q, k, v)
+    return ulysses_self_attention(q, k, v, mesh)

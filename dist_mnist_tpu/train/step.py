@@ -92,6 +92,11 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
     }
     if with_grad_norm:
         out["grad_norm"] = global_norm(grads)
+        # per-leaf norms as ONE vector: SummaryHook histograms it (the
+        # grad-distribution summary the reference wrote as histogram protos)
+        out["grad_norms"] = jnp.stack(
+            [jnp.linalg.norm(g.ravel()) for g in jax.tree.leaves(grads)]
+        )
     return new_state, out
 
 
@@ -114,7 +119,7 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1):
     """jit on first call, deriving state shardings from the live state."""
     compiled: dict = {}
 
-    def wrapper(state, *rest):
+    def _ensure_jit(state):
         if "fn" not in compiled:
             shd = tree_sharding(state, mesh, rules)
             batch_shd = {"image": batch_sharding(mesh),
@@ -124,8 +129,26 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1):
                 step, in_shardings=in_shd, out_shardings=(shd, None),
                 donate_argnums=(0,) if donate else (),
             )
+
+    def wrapper(state, *rest):
+        _ensure_jit(state)
         return compiled["fn"](state, *rest)
 
+    def cost_analysis(state, *rest):
+        """XLA's cost analysis (flops, bytes accessed) for ONE invocation —
+        the MFU numerator (utils/flops.py). lower+compile only (never
+        EXECUTES, so donated-buffer steps are safe to query before the
+        first real call); hits XLA's compilation cache when the step has
+        already run. Pass any args with the right shapes/shardings (e.g.
+        the step's own output state). None when the backend has no cost
+        model."""
+        _ensure_jit(state)
+        try:
+            return compiled["fn"].lower(state, *rest).compile().cost_analysis()
+        except Exception:  # noqa: BLE001 — metrics aid, never fail a run
+            return None
+
+    wrapper.cost_analysis = cost_analysis
     return wrapper
 
 

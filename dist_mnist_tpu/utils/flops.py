@@ -1,0 +1,65 @@
+"""FLOPs accounting: XLA-counted step FLOPs ÷ time ÷ chip peak = MFU.
+
+The reference stack had no FLOPs accounting at all (its per-step cost was
+dominated by the gRPC weight pull/grad push, SURVEY.md §3.3); on TPU the
+honest cross-dataset performance metric is model-FLOPs utilization — what
+fraction of the MXU's peak the training step sustains. The numerator comes
+from XLA's own cost model on the compiled program
+(`train/step.py` `wrapper.cost_analysis`), so it is the true compiled-op
+count, not a hand-derived estimate.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Peak dense bf16 matmul throughput per chip (FLOP/s), keyed by
+# `jax.Device.device_kind`. Public figures from the TPU system docs.
+PEAK_BF16_FLOPS: dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device: jax.Device | None = None) -> float | None:
+    """bf16 peak for `device` (default: first visible device); None when the
+    chip isn't in the table (CPU/GPU/unknown kind) — MFU is then unknowable
+    and must be reported as null, not guessed."""
+    device = device or jax.devices()[0]
+    return PEAK_BF16_FLOPS.get(device.device_kind)
+
+
+def step_flops(step_fn, *args) -> float | None:
+    """FLOPs XLA counts for one invocation of a `_lazy_jit` step wrapper
+    (or any object exposing `.cost_analysis(*args)` / a jitted fn).
+
+    NOTE (verified on this backend): XLA's HLO cost analysis counts a
+    `while`-loop body ONCE, regardless of trip count — so for a
+    `make_scanned_train_fn` chunk the returned number already IS the
+    per-STEP figure (one scan-body execution + the negligible epilogue),
+    not the per-chunk total. Do not divide by the chunk length."""
+    try:
+        cost = getattr(step_fn, "cost_analysis", None)
+        if cost is not None:
+            ca = cost(*args)
+        else:  # a plain jax.jit-ed function
+            ca = step_fn.lower(*args).compile().cost_analysis()
+    except Exception:  # noqa: BLE001 — metrics aid, never fail a run
+        return None
+    if ca is None:
+        return None
+    flops = ca.get("flops") if hasattr(ca, "get") else None
+    return float(flops) if flops else None
+
+
+def mfu(flops_per_step: float | None, step_secs: float,
+        device: jax.Device | None = None) -> float | None:
+    """Model-FLOPs utilization in [0, 1]; None when either side is unknown."""
+    peak = device_peak_flops(device)
+    if not flops_per_step or not peak or step_secs <= 0:
+        return None
+    return flops_per_step / step_secs / peak

@@ -44,7 +44,7 @@ def main():
     import jax.numpy as jnp
 
     from dist_mnist_tpu import optim
-    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
     from dist_mnist_tpu.data import DeviceDataset, ShardedBatcher, load_dataset
     from dist_mnist_tpu.models import get_model
     from dist_mnist_tpu.parallel.sharding import shard_train_state
@@ -63,7 +63,7 @@ def main():
     optimizer = optim.adam(1e-3)
     results = []
 
-    with mesh:
+    with activate(mesh):
         dd = DeviceDataset(dataset, mesh)
 
         # -- scan chunk size x dtype x remat --------------------------------

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dist_mnist_tpu.data import synthetic
 from dist_mnist_tpu.data.datasets import load_dataset
@@ -104,6 +105,7 @@ def test_sharded_batcher_rejects_oversized_batch(mesh8, small_mnist):
         next(iter(ShardedBatcher(small_mnist, 1 << 20, mesh8)))
 
 
+@pytest.mark.slow
 def test_synthetic_cache_roundtrip(tmp_path):
     """Full-size synthetic twins cache to disk atomically, reload fast, and
     KEEP synthetic=True (the marker file); corrupt files fall back."""
@@ -258,6 +260,7 @@ def test_random_crop_flip_properties():
         assert set(np.unique(out1[i])) <= set(np.unique(imgs[i]))
 
 
+@pytest.mark.slow
 def test_augmented_step_trains(mesh8, small_mnist):
     """augment=True composes with the jitted step (static shapes, grads)."""
     from dist_mnist_tpu import optim

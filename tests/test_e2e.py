@@ -13,10 +13,11 @@ from dist_mnist_tpu.configs import CONFIGS, get_config
 def test_config_registry_covers_ladder():
     assert set(CONFIGS) == {
         "mlp_mnist", "lenet5_mnist", "lenet5_fashion",
-        "resnet20_cifar", "vit_tiny_cifar",
+        "resnet20_cifar", "vit_tiny_cifar", "vit_tiny_cifar_ulysses",
     }
 
 
+@pytest.mark.slow
 def test_mlp_mnist_e2e(tmp_path):
     cfg = get_config("mlp_mnist", train_steps=250, eval_every=0)
     state, final, ctx = run_config(cfg, data_dir=str(tmp_path / "data"),
@@ -26,6 +27,7 @@ def test_mlp_mnist_e2e(tmp_path):
     assert (tmp_path / "logs" / "metrics.csv").exists()
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_through_driver(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     cfg = get_config("mlp_mnist", train_steps=30, eval_every=0)
@@ -38,6 +40,7 @@ def test_checkpoint_resume_through_driver(tmp_path):
     assert s2.step_int == 60
 
 
+@pytest.mark.slow
 def test_resume_matches_uninterrupted_trajectory(tmp_path):
     """Save at 30, restart, run to 60 — params must equal a straight 60-step
     run. This is STRONGER than the reference could do: the batcher re-seeks

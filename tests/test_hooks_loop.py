@@ -282,6 +282,66 @@ def test_global_step_waiter_reloads_bare_managers():
     assert mgr.reloads == 3
 
 
+class _RecWriter:
+    def __init__(self):
+        self.scalars = []
+        self.hists = []
+
+    def scalar(self, tag, value, step):
+        self.scalars.append((step, tag, value))
+
+    def histogram(self, tag, values, step):
+        import numpy as np
+
+        self.hists.append((step, tag, int(np.asarray(values).size)))
+
+    def flush(self):
+        pass
+
+
+def test_summary_hook_histograms_array_outputs():
+    """Array-valued step outputs (e.g. per-leaf grad_norms) become
+    histograms; scalars stay scalars."""
+    from dist_mnist_tpu.hooks import SummaryHook
+
+    def step_with_vec(state, batch):
+        new, out = _fake_step(state, batch)
+        out["grad_norms"] = jnp.arange(5.0)
+        return new, out
+
+    w = _RecWriter()
+    loop = TrainLoop(step_with_vec, _state(), itertools.repeat(1.0),
+                     [SummaryHook(w, every_steps=2),
+                      StopAtStepHook(last_step=4)])
+    loop.run()
+    assert [(s, t) for s, t, _ in w.scalars] == [(2, "loss"), (4, "loss")]
+    assert w.hists == [(2, "grad_norms", 5), (4, "grad_norms", 5)]
+
+
+def test_summary_hook_param_histograms_cadence():
+    from dist_mnist_tpu.hooks import SummaryHook
+
+    state = TrainState(
+        step=jnp.int32(0),
+        params={"hid": {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}},
+        model_state={}, opt_state={}, rng=jnp.zeros((2,), jnp.uint32),
+    )
+
+    def step_keep_params(s, batch):
+        new, out = _fake_step(s, batch)
+        return TrainState(step=new.step, params=s.params, model_state={},
+                          opt_state={}, rng=s.rng), out
+
+    w = _RecWriter()
+    hook = SummaryHook(w, every_steps=100, param_histograms_every=3)
+    loop = TrainLoop(step_keep_params, state, itertools.repeat(1.0),
+                     [hook, StopAtStepHook(last_step=6)])
+    loop.run()
+    assert (3, "params/hid/w", 6) in w.hists
+    assert (3, "params/hid/b", 2) in w.hists
+    assert (6, "params/hid/w", 6) in w.hists
+
+
 def test_memory_profile_hook(tmp_path):
     from dist_mnist_tpu.hooks import MemoryProfileHook
 

@@ -56,6 +56,7 @@ def test_lenet_param_count(rng):
     assert param_count(params) == expected
 
 
+@pytest.mark.slow
 def test_resnet_batchnorm_state_updates(rng):
     model = get_model("resnet20")
     x = jnp.ones((8, 32, 32, 3))
@@ -70,12 +71,14 @@ def test_resnet_batchnorm_state_updates(rng):
     assert max(jax.tree.leaves(same)) == 0
 
 
+@pytest.mark.slow
 def test_vit_token_count(rng):
     model = get_model("vit_tiny", depth=1)
     params, _ = model.init(rng, jnp.zeros((1, 32, 32, 3)))
     assert params["pos"].shape == (1, 65, 192)  # 64 patches + CLS
 
 
+@pytest.mark.slow
 def test_dropout_only_in_train(rng):
     model = get_model("lenet5")
     x = jnp.array(np.random.default_rng(0).normal(size=(4, 28, 28, 1)),

@@ -85,6 +85,7 @@ def test_ps_sync_aggregation_and_tokens(ps_lib):
     assert ps.push_sync(np.array([1.0, 1.0], np.float32), 1)
 
 
+@pytest.mark.slow
 def test_ps_demo_end_to_end_both_modes(ps_lib, small_mnist):
     from dist_mnist_tpu.parallel.ps_demo import run_demo
 

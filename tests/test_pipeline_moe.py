@@ -44,6 +44,7 @@ def pipe_mesh():
 
 
 class TestPipeline:
+    @pytest.mark.slow
     def test_matches_sequential(self, pipe_mesh):
         dim, batch, n_stages = 16, 32, 4
         stages = _make_stages(jax.random.PRNGKey(0), n_stages, dim)
@@ -59,6 +60,7 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_differentiable(self, pipe_mesh):
         """grad flows through the ppermute schedule (the PP backward)."""
         dim, batch, n_stages = 8, 16, 4
@@ -117,6 +119,7 @@ def ep_mesh():
 
 
 class TestMoE:
+    @pytest.mark.slow
     def test_dense_routes_and_shapes(self):
         params = init_moe(jax.random.PRNGKey(0), dim=16, hidden=32,
                           n_experts=4)
@@ -127,6 +130,7 @@ class TestMoE:
         # aux of a perfectly uniform router is 1.0; any router is >= 1 - eps
         assert float(aux) >= 0.99
 
+    @pytest.mark.slow
     def test_distributed_matches_dense(self, ep_mesh):
         """With capacity >= all tokens nothing is dropped, so EP dispatch
         must reproduce the dense oracle bit-for-bit (same expert math)."""
@@ -145,6 +149,7 @@ class TestMoE:
             float(ep_aux), float(dense_aux), rtol=1e-5
         )
 
+    @pytest.mark.slow
     def test_distributed_differentiable(self, ep_mesh):
         """grad flows through both all_to_alls (EP backward)."""
         params = init_moe(jax.random.PRNGKey(4), dim=8, hidden=16,
@@ -162,6 +167,7 @@ class TestMoE:
         assert float(jnp.sum(jnp.abs(g["w1"]))) > 0
         assert float(jnp.sum(jnp.abs(g["gate"]))) > 0
 
+    @pytest.mark.slow
     def test_capacity_drops_tokens(self):
         """Switch semantics: over-capacity tokens contribute zero output."""
         params = init_moe(jax.random.PRNGKey(6), dim=8, hidden=16,
@@ -177,6 +183,7 @@ class TestMoE:
         dropped = np.asarray(out[4:])
         np.testing.assert_allclose(dropped, np.zeros_like(dropped), atol=0)
 
+    @pytest.mark.slow
     def test_expert_count_mismatch_raises(self, ep_mesh):
         params = init_moe(jax.random.PRNGKey(8), dim=8, hidden=16,
                           n_experts=2)  # != model axis 4

@@ -44,6 +44,25 @@ def test_summarize_trace(profile_dir):
         assert r["count"] >= 1 and r["avg_us"] <= r["total_us"] + 0.06
 
 
+def test_profiler_hook_end_exports(tmp_path):
+    """A run shorter than the trace window still gets the chrome-trace
+    export on end() — same path as the cadence stop (ADVICE r1 item 1)."""
+    from dist_mnist_tpu.hooks.builtin import ProfilerHook
+
+    class FakeLoop:
+        initial_step = 0
+
+    hook = ProfilerHook(str(tmp_path), start_step=0, num_steps=100)
+    hook.begin(FakeLoop())
+    hook.before_step(0)  # trace window opens
+    x = jnp.ones((128, 128))
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    hook.after_step(1, None, {"loss": x[0, 0]})  # window unfinished
+    hook.end(None)  # early end: must stop the trace AND export
+    assert latest_trace(tmp_path) is not None
+    assert list(tmp_path.rglob("timeline-*.json"))
+
+
 def test_summarize_synthetic_trace(tmp_path):
     """Deterministic check of aggregation math on a hand-written trace."""
     trace = {

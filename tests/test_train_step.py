@@ -46,6 +46,7 @@ def test_loss_decreases(mesh8):
     assert int(state.step_int) == 20
 
 
+@pytest.mark.slow
 def test_dp_matches_single_device(mesh8, mesh1):
     """8-way data-parallel must equal 1-device training on the same global
     batch — the correctness contract of replacing the PS push/pull with the
@@ -64,6 +65,34 @@ def test_dp_matches_single_device(mesh8, mesh1):
     w8 = np.asarray(s8.params["hid"]["w"])
     w1 = np.asarray(s1.params["hid"]["w"])
     np.testing.assert_allclose(w8, w1, rtol=2e-4, atol=2e-6)
+
+
+def test_with_grad_norm_outputs(mesh8):
+    """with_grad_norm emits the scalar global norm AND the per-leaf norm
+    vector (SummaryHook histograms the latter)."""
+    model = get_model("mlp", hidden_units=32)
+    opt = optim.adam(0.01)
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "image": rng.integers(0, 255, (32, 28, 28, 1), dtype=np.uint8),
+        "label": rng.integers(0, 10, (32,), dtype=np.int32),
+    }
+    with mesh8:
+        state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                   batch_np["image"][:1])
+        state = shard_train_state(state, mesh8)
+        step = make_train_step(model, opt, mesh8, donate=False,
+                               with_grad_norm=True)
+        _, out = step(state, shard_batch(batch_np, mesh8))
+    n_leaves = len(jax.tree.leaves(state.params))
+    assert out["grad_norm"].shape == ()
+    assert out["grad_norms"].shape == (n_leaves,)
+    # the vector and the scalar agree: ||g|| = sqrt(sum per-leaf ||g_i||^2)
+    np.testing.assert_allclose(
+        float(out["grad_norm"]),
+        float(jnp.sqrt(jnp.sum(out["grad_norms"] ** 2))),
+        rtol=1e-5,
+    )
 
 
 def test_metrics_replicated_scalars(mesh8):
@@ -183,6 +212,7 @@ def test_malformed_batch_rejected_at_trace_time(mesh8, small_mnist):
                          "label": small_mnist.train_labels[:8].astype("float32")})
 
 
+@pytest.mark.slow
 def test_remat_matches_plain(mesh8, small_mnist):
     """jax.checkpoint must change memory, never math: one step with and
     without remat produces identical params (same rng paths)."""
