@@ -95,6 +95,28 @@ def install_deadline(metric: str, seconds: int) -> None:
     signal.alarm(seconds)
 
 
+def _anchor_fields(metric: str, value: float) -> dict:
+    """Regression guard: compare against the last committed on-chip number
+    (docs/PERF_ANCHOR.json, updated when docs/PERF.md is refreshed). Only
+    emitted when the running chip's device_kind matches the anchor's — a
+    cross-hardware ratio would read as a fake regression."""
+    import jax
+
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", "PERF_ANCHOR.json")) as fh:
+            anchors = json.load(fh)
+        anchor = anchors.get(metric)
+        kind = jax.devices()[0].device_kind
+        if (isinstance(anchor, dict) and anchor.get("value")
+                and anchor.get("device_kind") == kind):
+            return {"anchor": anchor["value"],
+                    "vs_anchor": round(value / anchor["value"], 3)}
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
 def _mfu_fields(run, state, dt_per_step: float):
     """MFU block from the compiled step's XLA cost analysis. XLA counts a
     scan body once (utils/flops.py), so `step_flops` of the scanned chunk
@@ -185,6 +207,7 @@ def bench_config(name: str, n_timed: int) -> int:
             "global_batch": cfg.batch_size,
             "examples_per_sec": round(rate * n_chips * cfg.batch_size),
             **mfu_block,
+            **_anchor_fields(f"{name}_steps_per_sec_per_chip", rate),
         },
     })
     return 0
@@ -262,6 +285,7 @@ def main() -> int:
             "global_batch": batch,
             "examples_per_sec": round(steps_per_sec_per_chip * n_chips * batch),
             **mfu_block,
+            **_anchor_fields(HEADLINE_METRIC, steps_per_sec_per_chip),
             "accuracy_race": {
                 "target": ">=99% test acc in <60s (north star; REAL MNIST)",
                 "provenance": (

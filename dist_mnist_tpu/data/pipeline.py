@@ -123,18 +123,66 @@ class DeviceDataset:
     replacement batch via on-device RNG, so step latency has no host
     component at all. Images stay uint8 in HBM (4x less capacity/bandwidth
     than f32) and are normalized after the gather, on the sharded batch.
+
+    Two residency modes:
+    - `shard=False` (default): dataset REPLICATED per device — right for
+      MNIST-class sizes (~11 MB), zero-communication gathers.
+    - `shard=True`: dataset rows SHARDED over the `data` axis — per-device
+      HBM cost is 1/data_axis of the dataset, so capacity scales with the
+      mesh instead of capping at one chip's HBM. Each device samples from
+      its own shard only (after a one-time deterministic global shuffle, so
+      shards are i.i.d.); the gather stays device-local — no collectives.
     """
 
-    def __init__(self, dataset: Dataset, mesh: Mesh):
+    def __init__(self, dataset: Dataset, mesh: Mesh, *, shard: bool = False,
+                 seed: int = 0):
         self.mesh = mesh
+        self.sharded = shard
         self.n = dataset.train_images.shape[0]
-        rep = NamedSharding(mesh, P())  # replicated: gather needs all rows
-        self.images = jax.device_put(dataset.train_images, rep)
-        self.labels = jax.device_put(dataset.train_labels, rep)
+        images, labels = dataset.train_images, dataset.train_labels
+        if shard:
+            data_axis = mesh.shape[DATA_AXIS]
+            # one-time global shuffle so class structure in file order
+            # (e.g. class-sorted synthetic sets) cannot skew any shard
+            perm = np.random.Generator(
+                np.random.Philox(key=[seed, 0xD5])
+            ).permutation(self.n)
+            keep = (self.n // data_axis) * data_axis  # equal shards
+            images, labels = images[perm[:keep]], labels[perm[:keep]]
+            self.n = keep
+            placement = NamedSharding(mesh, P(DATA_AXIS))
+        else:
+            placement = NamedSharding(mesh, P())  # gather needs all rows
+        self.images = jax.device_put(images, placement)
+        self.labels = jax.device_put(labels, placement)
 
     def sample(self, key: jax.Array, batch: int) -> dict[str, jax.Array]:
+        if self.sharded:
+            return self._sample_sharded(key, batch)
         idx = jax.random.randint(key, (batch,), 0, self.n)
         sharded = batch_sharding(self.mesh)
         img = jax.lax.with_sharding_constraint(jnp.take(self.images, idx, 0), sharded)
         lab = jax.lax.with_sharding_constraint(jnp.take(self.labels, idx, 0), sharded)
+        return {"image": img, "label": lab}
+
+    def _sample_sharded(self, key: jax.Array, batch: int) -> dict[str, jax.Array]:
+        """Each device draws its slice of the batch from its LOCAL rows —
+        the gather never leaves the device (shard_map over `data`)."""
+        data_axis = self.mesh.shape[DATA_AXIS]
+        if batch % data_axis:
+            raise ValueError(f"batch {batch} % data axis {data_axis} != 0")
+        per_dev = batch // data_axis
+
+        def local_sample(key, images, labels):
+            k = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            idx = jax.random.randint(k, (per_dev,), 0, images.shape[0])
+            return jnp.take(images, idx, 0), jnp.take(labels, idx, 0)
+
+        img, lab = jax.shard_map(
+            local_sample,
+            mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            check_vma=False,
+        )(key, self.images, self.labels)
         return {"image": img, "label": lab}

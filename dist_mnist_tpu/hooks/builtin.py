@@ -176,12 +176,23 @@ class SummaryHook(Hook):
         self._timer.mark()
         for k, v in outputs.items():
             if getattr(v, "size", 1) > 1:
-                self._writer.histogram(k, jax.device_get(v), step)
+                self._write_histogram(k, jax.device_get(v), step)
                 continue
             try:
                 self._writer.scalar(k, float(v), step)
             except (TypeError, ValueError):
                 pass
+
+    def _write_histogram(self, tag, values, step):
+        if hasattr(self._writer, "histogram"):
+            self._writer.histogram(tag, values, step)
+            return
+        # pre-histogram custom writers (scalar/flush-only MetricWriter
+        # protocol): degrade to summary-stat scalars instead of crashing
+        from dist_mnist_tpu.obs.writers import _summary_stats
+
+        for k, v in _summary_stats(values).items():
+            self._writer.scalar(f"{tag}/{k}", v, step)
 
     def _write_param_histograms(self, step, state):
         from dist_mnist_tpu.parallel.sharding import _paths
@@ -189,8 +200,8 @@ class SummaryHook(Hook):
         flat, _, paths = _paths(state.params)
         for path, (_, leaf) in zip(paths, flat):
             if getattr(leaf, "size", 0):
-                self._writer.histogram(f"params/{path}",
-                                       jax.device_get(leaf), step)
+                self._write_histogram(f"params/{path}",
+                                      jax.device_get(leaf), step)
 
     def end(self, state):
         self._writer.flush()

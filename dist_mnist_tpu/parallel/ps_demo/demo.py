@@ -8,6 +8,14 @@ blocking structure are identical):
   accumulators + token queue, all native — rows 8-12),
 - each Python thread plays a `worker` job: pull params, compute gradients
   on its own batch stream (real JAX autodiff on CPU), push,
+
+This is a PROTOCOL demo, not a concurrency-parity claim: workers are
+threads, so Python-side gradient compute serializes under the GIL (the
+reference's workers were processes). What it faithfully reproduces is the
+blocking structure — stale-grad drop, take_grad(n) aggregation, the token
+barrier — whose state machines live in the C++ server and release the GIL
+while blocking. For real multi-process training use the SPMD path
+(`cli/launch.py`).
 - async mode: push applies immediately; staleness tolerated/bounded,
 - sync mode (`--sync_replicas`): pushes feed the accumulator; worker 0
   doubles as chief running the aggregate->apply->token loop; workers block

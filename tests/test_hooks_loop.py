@@ -318,6 +318,36 @@ def test_summary_hook_histograms_array_outputs():
     assert w.hists == [(2, "grad_norms", 5), (4, "grad_norms", 5)]
 
 
+def test_summary_hook_degrades_for_scalar_only_writer():
+    """A pre-histogram custom writer (scalar/flush only) must not crash:
+    array outputs degrade to summary-stat scalars."""
+    from dist_mnist_tpu.hooks import SummaryHook
+
+    class OldWriter:
+        def __init__(self):
+            self.scalars = []
+
+        def scalar(self, tag, value, step):
+            self.scalars.append((step, tag, value))
+
+        def flush(self):
+            pass
+
+    def step_with_vec(state, batch):
+        new, out = _fake_step(state, batch)
+        out["grad_norms"] = jnp.arange(4.0)
+        return new, out
+
+    w = OldWriter()
+    loop = TrainLoop(step_with_vec, _state(), itertools.repeat(1.0),
+                     [SummaryHook(w, every_steps=2),
+                      StopAtStepHook(last_step=2)])
+    loop.run()
+    tags = {t for _, t, _ in w.scalars}
+    assert "grad_norms/mean" in tags and "grad_norms/max" in tags
+    assert "loss" in tags
+
+
 def test_summary_hook_param_histograms_cadence():
     from dist_mnist_tpu.hooks import SummaryHook
 
