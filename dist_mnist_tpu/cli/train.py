@@ -65,11 +65,20 @@ flags.DEFINE_boolean("profile", False, "trace a window of steps to logdir")
 flags.DEFINE_integer("eval_every", None, "eval cadence in steps; 0 disables "
                      "(None = config value)")
 flags.DEFINE_integer("log_every", None, "log/summary cadence in steps")
-flags.DEFINE_enum("input_pipeline", "python", ["python", "native"],
-                  "batcher implementation: python (numpy) or native "
-                  "(C++ prefetch ring, data/native)")
+flags.DEFINE_enum("input_pipeline", "python",
+                  ["python", "native", "device", "device_sharded"],
+                  "input path: python (numpy host batcher) | native (C++ "
+                  "prefetch ring) | device (dataset resident in HBM, "
+                  "with-replacement sampling fused into the compiled step — "
+                  "zero host work per step) | device_sharded (same, rows "
+                  "sharded over the data axis for capacity)")
 flags.DEFINE_integer("max_recoveries", 3,
                      "preemption restore attempts (needs checkpoint_dir)")
+flags.DEFINE_integer("scan_chunk", 0,
+                     "compile N steps into one lax.scan program (needs a "
+                     "device input pipeline); hooks fire per chunk. The "
+                     "bench-grade zero-dispatch path; 0 = one program per "
+                     "step")
 
 
 def build_optimizer(cfg):
@@ -120,6 +129,7 @@ def run_config(
     extra_hooks=(),
     mesh=None,
     input_pipeline: str = "python",
+    scan_chunk: int = 0,
 ):
     """Programmatic entrypoint (tests/bench call this; main() parses flags).
 
@@ -174,8 +184,50 @@ def run_config(
             cfg.name, cfg.model, jax.device_count(), restored,
         )
 
-        step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn,
-                                  remat=cfg.remat, augment=cfg.augment)
+        if scan_chunk and not input_pipeline.startswith("device"):
+            raise ValueError(
+                "--scan_chunk needs an in-program input path "
+                "(--input_pipeline=device|device_sharded): a host batcher "
+                "cannot feed a compiled multi-step scan"
+            )
+        if scan_chunk and cfg.train_steps % scan_chunk:
+            stop_at = -(-cfg.train_steps // scan_chunk) * scan_chunk
+            log.warning(
+                "train_steps=%d is not a multiple of scan_chunk=%d: the "
+                "loop stops at the chunk boundary, step %d (%d extra "
+                "steps, past the LR schedule horizon)",
+                cfg.train_steps, scan_chunk, stop_at,
+                stop_at - cfg.train_steps,
+            )
+        if input_pipeline.startswith("device"):
+            # input fused into the program (train/step.py): the dataset
+            # lives in HBM and each step samples on-device — no feed at
+            # all. Resume-exact for free: sampling is a pure function of
+            # (state.rng, state.step). Semantics: with-replacement draws
+            # (vs the host paths' shuffled epochs) — documented trade.
+            from dist_mnist_tpu.data import DeviceDataset
+            from dist_mnist_tpu.train.step import (
+                make_fused_train_step,
+                make_scanned_train_fn,
+            )
+
+            dd = DeviceDataset(dataset, mesh,
+                               shard=input_pipeline == "device_sharded",
+                               seed=cfg.seed)
+            if scan_chunk:
+                run = make_scanned_train_fn(
+                    model, optimizer, mesh, dd, cfg.batch_size, scan_chunk,
+                    loss_fn=loss_fn, remat=cfg.remat, augment=cfg.augment,
+                )
+            else:
+                run = make_fused_train_step(
+                    model, optimizer, mesh, dd, cfg.batch_size,
+                    loss_fn=loss_fn, remat=cfg.remat, augment=cfg.augment,
+                )
+            step_fn = lambda state, _batch: run(state)
+        else:
+            step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn,
+                                      remat=cfg.remat, augment=cfg.augment)
         eval_step = make_eval_step(model, mesh)
         eval_fn = lambda s: evaluate(
             eval_step, s, dataset.test_images, dataset.test_labels, mesh
@@ -211,7 +263,11 @@ def run_config(
         # post-restore trajectory equals the uninterrupted one (the
         # reference replayed the epoch from scratch — next_batch state died
         # with the process, SURVEY.md §3.5)
-        if input_pipeline == "native":
+        if input_pipeline.startswith("device"):
+            import itertools
+
+            batches = itertools.repeat(None)  # sampling lives in the step
+        elif input_pipeline == "native":
             from dist_mnist_tpu.data.native import NativeBatcher
 
             batches = NativeBatcher(dataset, cfg.batch_size, mesh,
@@ -228,6 +284,7 @@ def run_config(
             hooks,
             checkpoint_manager=manager,
             max_recoveries=max_recoveries,
+            steps_per_call=max(1, scan_chunk),
         )
         state = loop.run()
         # EvalHook.end already evaluated the final state; don't pay for a
@@ -316,6 +373,7 @@ def main(argv):
         profile=FLAGS.profile,
         max_recoveries=FLAGS.max_recoveries if FLAGS.checkpoint_dir else 0,
         input_pipeline=FLAGS.input_pipeline,
+        scan_chunk=FLAGS.scan_chunk,
     )
 
 

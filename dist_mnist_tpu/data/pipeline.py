@@ -153,19 +153,44 @@ class DeviceDataset:
             placement = NamedSharding(mesh, P(DATA_AXIS))
         else:
             placement = NamedSharding(mesh, P())  # gather needs all rows
-        self.images = jax.device_put(images, placement)
-        self.labels = jax.device_put(labels, placement)
+        if jax.process_count() == 1:
+            self.images = jax.device_put(images, placement)
+            self.labels = jax.device_put(labels, placement)
+        else:
+            # multi-process: device_put cannot target non-addressable
+            # devices; every process holds the full (identically-loaded)
+            # array, so the callback hands each addressable shard its
+            # global slice (same reason shard_batch branches above)
+            put = lambda arr: jax.make_array_from_callback(
+                arr.shape, placement, lambda idx, a=arr: a[idx]
+            )
+            self.images = put(images)
+            self.labels = put(labels)
+
+    @property
+    def arrays(self) -> tuple[jax.Array, jax.Array]:
+        """The resident arrays, for passing INTO a jitted step as explicit
+        arguments (required in multi-process runs: closing over an array
+        that spans non-addressable devices is illegal)."""
+        return self.images, self.labels
 
     def sample(self, key: jax.Array, batch: int) -> dict[str, jax.Array]:
+        return self.sample_arrays(key, batch, self.images, self.labels)
+
+    def sample_arrays(self, key: jax.Array, batch: int, images, labels
+                      ) -> dict[str, jax.Array]:
+        """Sampling body usable on traced arguments (images/labels may be
+        jit tracers — see `arrays`)."""
         if self.sharded:
-            return self._sample_sharded(key, batch)
+            return self._sample_sharded(key, batch, images, labels)
         idx = jax.random.randint(key, (batch,), 0, self.n)
         sharded = batch_sharding(self.mesh)
-        img = jax.lax.with_sharding_constraint(jnp.take(self.images, idx, 0), sharded)
-        lab = jax.lax.with_sharding_constraint(jnp.take(self.labels, idx, 0), sharded)
+        img = jax.lax.with_sharding_constraint(jnp.take(images, idx, 0), sharded)
+        lab = jax.lax.with_sharding_constraint(jnp.take(labels, idx, 0), sharded)
         return {"image": img, "label": lab}
 
-    def _sample_sharded(self, key: jax.Array, batch: int) -> dict[str, jax.Array]:
+    def _sample_sharded(self, key: jax.Array, batch: int, images, labels
+                        ) -> dict[str, jax.Array]:
         """Each device draws its slice of the batch from its LOCAL rows —
         the gather never leaves the device (shard_map over `data`)."""
         data_axis = self.mesh.shape[DATA_AXIS]
@@ -184,5 +209,5 @@ class DeviceDataset:
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
             check_vma=False,
-        )(key, self.images, self.labels)
+        )(key, images, labels)
         return {"image": img, "label": lab}

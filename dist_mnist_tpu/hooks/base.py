@@ -48,10 +48,28 @@ class EverySteps:
         self.every_steps = every_steps
         self.every_secs = every_secs
         self._last_time = time.monotonic()
+        self._last_step: int | None = None
+
+    def prime(self, step: int) -> None:
+        """Anchor the crossing detector at the run's initial step (hooks
+        call this from begin(loop)). Without it the FIRST observation has
+        no predecessor, so a chunk that crosses a multiple without landing
+        on one (e.g. first after_step(150) with every=100) can't be seen
+        as a crossing."""
+        self._last_step = step
 
     def should_trigger(self, step: int) -> bool:
-        if self.every_steps is not None and step % self.every_steps == 0:
-            return True
+        """True when a step multiple was REACHED OR CROSSED since the last
+        observed step — not bare `step % every == 0`, which silently aliases
+        when the loop advances in chunks (scan_chunk: steps arrive as
+        64, 128, ... and would hit a multiple of 100 only at the LCM)."""
+        if self.every_steps is not None:
+            prev, self._last_step = self._last_step, step
+            if prev is None:
+                if step % self.every_steps == 0:
+                    return True
+            elif step // self.every_steps > prev // self.every_steps:
+                return True
         if (
             self.every_secs is not None
             and time.monotonic() - self._last_time >= self.every_secs

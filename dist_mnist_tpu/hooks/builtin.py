@@ -57,6 +57,7 @@ class StepCounterHook(Hook):
     def begin(self, loop):
         self._last_step = loop.initial_step
         self._last_time = time.monotonic()
+        self._timer.prime(loop.initial_step)
 
     def after_step(self, step, state, outputs):
         if not self._timer.should_trigger(step):
@@ -81,6 +82,9 @@ class LoggingHook(Hook):
     def __init__(self, every_steps: int = 100, keys: tuple[str, ...] | None = None):
         self._timer = EverySteps(every_steps=every_steps)
         self._keys = keys
+
+    def begin(self, loop):
+        self._timer.prime(loop.initial_step)
 
     def after_step(self, step, state, outputs):
         if not self._timer.should_trigger(step):
@@ -107,6 +111,7 @@ class NaNGuardHook(Hook):
 
     def begin(self, loop):
         self._loop = loop
+        self._timer.prime(loop.initial_step)
 
     def after_step(self, step, state, outputs):
         if self._key not in outputs or not self._timer.should_trigger(step):
@@ -134,6 +139,7 @@ class CheckpointHook(Hook):
         self._loop = loop
         # save-on-create (:585-602): guarantees a restore point exists before
         # the first cadence trigger; a restored state dedupes by step.
+        self._timer.prime(loop.initial_step)
         self._mgr.save(loop.state)
 
     def after_step(self, step, state, outputs):
@@ -166,6 +172,11 @@ class SummaryHook(Hook):
             EverySteps(every_steps=param_histograms_every)
             if param_histograms_every else None
         )
+
+    def begin(self, loop):
+        self._timer.prime(loop.initial_step)
+        if self._param_timer:
+            self._param_timer.prime(loop.initial_step)
 
     def after_step(self, step, state, outputs):
         if self._param_timer and self._param_timer.should_trigger(step):
@@ -219,21 +230,34 @@ class ProfilerHook(Hook):
         self._num = num_steps
         self._start = self._stop = None
         self._active = False
+        self._done = False
 
     def begin(self, loop):
         # anchor to the restored step — a run resumed at step 100 traces
-        # steps 110..112, not never
-        self._start = loop.initial_step + self._start_offset
+        # steps 110..112, not never. Under a chunked loop (steps_per_call
+        # > 1) before_step only ever sees chunk boundaries, so align the
+        # window start DOWN to the boundary whose chunk contains it — the
+        # trace then covers that whole chunk (incl. a single-chunk run
+        # where before_step(0) is the only pre-window call).
+        stride = getattr(loop, "steps_per_call", 1)
+        offset = (self._start_offset // stride) * stride if stride > 1 \
+            else self._start_offset
+        self._start = loop.initial_step + offset
         self._stop = self._start + self._num
 
     def before_step(self, step):
-        if step == self._start and not self._active:
+        # >= not ==: a chunked loop (scan_chunk) strides past the exact
+        # start step; the trace then covers whole chunks (the finest
+        # granularity a compiled multi-step program can offer). _done
+        # guards against restarting once the window has been captured.
+        if not self._done and not self._active and step >= self._start:
             jax.profiler.start_trace(self._logdir)
             self._active = True
 
     def _stop_and_export(self):
         jax.profiler.stop_trace()
         self._active = False
+        self._done = True
         log.info("profile (window [%d, %d)) -> %s",
                  self._start, self._stop, self._logdir)
         try:
@@ -398,6 +422,9 @@ class EvalHook(Hook):
         self._name = name
         self.last_result: dict | None = None
         self._last_eval_step: int | None = None
+
+    def begin(self, loop):
+        self._timer.prime(loop.initial_step)
 
     def _run(self, step, state):
         res = self._eval(state)

@@ -83,6 +83,7 @@ class TrainLoop:
         *,
         checkpoint_manager=None,
         max_recoveries: int = 0,
+        steps_per_call: int = 1,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -91,6 +92,10 @@ class TrainLoop:
         self.stop = StopSignal()
         self.checkpoint_manager = checkpoint_manager
         self.max_recoveries = max_recoveries
+        # >1 when step_fn executes a compiled CHUNK of steps (lax.scan —
+        # train/step.make_scanned_train_fn): hooks fire once per chunk at
+        # the post-chunk step number; cadences/stops round up to the chunk.
+        self.steps_per_call = steps_per_call
         self.initial_step = state.step_int
         self._host_step = self.initial_step  # host mirror of state.step:
         # tracks the global step without a device sync per step
@@ -118,7 +123,7 @@ class TrainLoop:
                         h.before_step(self._host_step)
                     new_state, outputs = self.step_fn(self.state, batch)
                     self.state = new_state
-                    self._host_step += 1
+                    self._host_step += self.steps_per_call
                     for h in self.hooks:
                         h.after_step(self._host_step, self.state, outputs)
                 except Exception as exc:  # noqa: BLE001 — classified below

@@ -102,37 +102,57 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
 
 def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
                     remat: bool = False, augment: bool = False):
-    """One step with batch sampling inside the program (fused-input body)."""
+    """One step with batch sampling inside the program (fused-input body).
+    The resident dataset arrays arrive as EXPLICIT args (`data`), never as
+    closed-over constants — a multi-process global array may not be
+    captured by a jit (it spans non-addressable devices)."""
 
-    def one_step(state: TrainState):
+    def one_step(state: TrainState, data):
+        images, labels = data
         sample_key, dropout_key = jax.random.split(
             jax.random.fold_in(state.rng, state.step)
         )
-        batch = device_dataset.sample(sample_key, batch_size)
+        batch = device_dataset.sample_arrays(sample_key, batch_size,
+                                             images, labels)
         return _train_core(model, optimizer, loss_fn, state, batch,
                            dropout_key, remat=remat, augment=augment)
 
     return one_step
 
 
-def _lazy_jit(step, mesh, rules, donate, n_args=1):
-    """jit on first call, deriving state shardings from the live state."""
+def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None):
+    """jit on first call, deriving state shardings from the live state.
+
+    `bound_data`: resident arrays (e.g. a DeviceDataset's) passed as the
+    step's second argument ON EVERY CALL, with their own shardings — an
+    explicit arg, never a closed-over constant, because a multi-process
+    global array may not be captured by a jit (it spans non-addressable
+    devices). Callers of the returned wrapper then pass only `state`.
+    """
     compiled: dict = {}
 
     def _ensure_jit(state):
         if "fn" not in compiled:
             shd = tree_sharding(state, mesh, rules)
-            batch_shd = {"image": batch_sharding(mesh),
-                         "label": batch_sharding(mesh)}
-            in_shd = (shd,) + ((batch_shd,) if n_args == 2 else ())
+            if bound_data is not None:
+                extra_shd = (tuple(a.sharding for a in bound_data),)
+            elif n_args == 2:
+                extra_shd = ({"image": batch_sharding(mesh),
+                              "label": batch_sharding(mesh)},)
+            else:
+                extra_shd = ()
             compiled["fn"] = jax.jit(
-                step, in_shardings=in_shd, out_shardings=(shd, None),
+                step, in_shardings=(shd,) + extra_shd,
+                out_shardings=(shd, None),
                 donate_argnums=(0,) if donate else (),
             )
 
+    def _args(rest):
+        return (bound_data,) if bound_data is not None else rest
+
     def wrapper(state, *rest):
         _ensure_jit(state)
-        return compiled["fn"](state, *rest)
+        return compiled["fn"](state, *_args(rest))
 
     def cost_analysis(state, *rest):
         """XLA's cost analysis (flops, bytes accessed) for ONE invocation —
@@ -144,7 +164,9 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1):
         model."""
         _ensure_jit(state)
         try:
-            return compiled["fn"].lower(state, *rest).compile().cost_analysis()
+            return compiled["fn"].lower(
+                state, *_args(rest)
+            ).compile().cost_analysis()
         except Exception:  # noqa: BLE001 — metrics aid, never fail a run
             return None
 
@@ -202,7 +224,8 @@ def make_fused_train_step(
     loop's shuffled epochs)."""
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
                                batch_size, remat=remat, augment=augment)
-    return _lazy_jit(one_step, mesh, rules, donate=True)
+    return _lazy_jit(one_step, mesh, rules, donate=True,
+                     bound_data=device_dataset.arrays)
 
 
 def make_scanned_train_fn(
@@ -228,13 +251,14 @@ def make_scanned_train_fn(
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
                                batch_size, remat=remat, augment=augment)
 
-    def run_chunk(state: TrainState):
+    def run_chunk(state: TrainState, data):
         state, outs = jax.lax.scan(
-            lambda s, _: one_step(s), state, None, length=chunk
+            lambda s, _: one_step(s, data), state, None, length=chunk
         )
         return state, jax.tree.map(jnp.mean, outs)
 
-    return _lazy_jit(run_chunk, mesh, rules, donate=True)
+    return _lazy_jit(run_chunk, mesh, rules, donate=True,
+                     bound_data=device_dataset.arrays)
 
 
 def make_eval_step(model, mesh: Mesh):

@@ -51,6 +51,31 @@ def test_stop_num_steps_from_restore():
     assert loop.run().step_int == 15
 
 
+def test_steps_per_call_chunked_loop():
+    """steps_per_call=K (compiled scan chunks): hooks fire once per chunk
+    at the post-chunk step; stop rounds up to the chunk boundary."""
+    def chunk_step(state, batch):  # pretends to run 10 steps in one call
+        return (
+            TrainState(step=state.step + 10, params=state.params,
+                       model_state=state.model_state,
+                       opt_state=state.opt_state, rng=state.rng),
+            {"loss": jnp.float32(1.0)},
+        )
+
+    seen = []
+
+    class Rec(Hook):
+        def after_step(self, step, state, outputs):
+            seen.append(step)
+
+    loop = TrainLoop(chunk_step, _state(), itertools.repeat(None),
+                     [Rec(), StopAtStepHook(last_step=25)],
+                     steps_per_call=10)
+    final = loop.run()
+    assert seen == [10, 20, 30]  # stop rounds up to the chunk boundary
+    assert final.step_int == 30
+
+
 def test_data_exhaustion_stops():
     loop = TrainLoop(_fake_step, _state(), iter([1.0, 1.0, 1.0]), [])
     assert loop.run().step_int == 3
@@ -116,6 +141,31 @@ def test_eval_hook_cadence_and_end():
 def test_every_steps_requires_config():
     with pytest.raises(ValueError):
         EverySteps()
+
+
+def test_every_steps_crossing_not_aliasing():
+    """Chunk-strided step numbers (scan_chunk) must trigger whenever a
+    cadence multiple is crossed — bare `step % every == 0` would alias to
+    the LCM (e.g. every 1600 steps for chunk=64, every=100)."""
+    t = EverySteps(every_steps=100)
+    t.prime(0)
+    fired = [s for s in range(64, 1700, 64) if t.should_trigger(s)]
+    # one firing per crossed multiple of 100 (100..1600 = 16 of them)
+    assert len(fired) == 16
+    assert fired[:3] == [128, 256, 320]
+    # per-step striding keeps the exact-multiple behavior
+    t2 = EverySteps(every_steps=4)
+    t2.prime(0)
+    assert [s for s in range(1, 11) if t2.should_trigger(s)] == [4, 8]
+    # the FIRST observation can itself be a crossing (chunk 150, every 100)
+    t3 = EverySteps(every_steps=100)
+    t3.prime(0)
+    assert t3.should_trigger(150)
+    # a primed timer at a restored step doesn't fire spuriously
+    t4 = EverySteps(every_steps=100)
+    t4.prime(5000)
+    assert not t4.should_trigger(5001)
+    assert t4.should_trigger(5100)
 
 
 def test_stop_signal_exception_channel():

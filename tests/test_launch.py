@@ -72,6 +72,48 @@ def test_two_process_training(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_device_pipeline(tmp_path):
+    """The fused device input path on a REAL 2-process cluster: dataset
+    rows sharded across BOTH processes' devices (make_array_from_callback —
+    device_put can't reach non-addressable devices), sampling in-program,
+    scan-chunked loop. Both processes must converge identically."""
+    import contextlib
+    import io
+
+    data_dir = str(tmp_path / "data")
+    r = subprocess.run(
+        [sys.executable, "-m", "dist_mnist_tpu.cli.train",
+         "--download_only", f"--data_dir={data_dir}",
+         "--config=mlp_mnist", "--platform=cpu"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = launch(
+            2,
+            [
+                "--config=mlp_mnist",
+                f"--data_dir={data_dir}",
+                "--train_steps=6",
+                "--batch_size=32",
+                "--eval_every=0",
+                "--input_pipeline=device_sharded",
+                "--scan_chunk=3",
+            ],
+            platform="cpu",
+            devices_per_process=2,
+        )
+    log = buf.getvalue()
+    assert rc == 0, log
+    finals = re.findall(r"\[p(\d)\].*done: step=(\d+) test_acc=([0-9.]+)", log)
+    assert sorted(f[0] for f in finals) == ["0", "1"], log
+    assert all(f[1] == "6" for f in finals), finals
+    assert finals[0][2] == finals[1][2], finals
+
+
+@pytest.mark.slow
 def test_launch_propagates_child_failure(tmp_path):
     rc = launch(
         2,

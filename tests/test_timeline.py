@@ -63,6 +63,49 @@ def test_profiler_hook_end_exports(tmp_path):
     assert list(tmp_path.rglob("timeline-*.json"))
 
 
+def test_profiler_hook_chunked_loop_still_traces(tmp_path):
+    """A chunked loop strides past the exact start step; the hook must
+    still capture a window (and not restart after it completed)."""
+    from dist_mnist_tpu.hooks.builtin import ProfilerHook
+
+    class FakeLoop:
+        initial_step = 0
+
+    hook = ProfilerHook(str(tmp_path), start_step=10, num_steps=3)
+    hook.begin(FakeLoop())
+    hook.before_step(0)
+    assert not hook._active  # window not reached yet
+    hook.before_step(100)  # strides past start=10 -> trace opens
+    assert hook._active
+    x = jnp.ones((64, 64))
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    hook.after_step(200, None, {"loss": x[0, 0]})  # past stop -> closes
+    assert not hook._active
+    hook.before_step(300)  # completed window must NOT restart
+    assert not hook._active
+    assert latest_trace(tmp_path) is not None
+
+
+def test_profiler_hook_single_chunk_run_traces(tmp_path):
+    """When the whole run is ONE scan chunk, the window start aligns down
+    to the chunk boundary so the (only) chunk is the one traced."""
+    from dist_mnist_tpu.hooks.builtin import ProfilerHook
+
+    class ChunkedLoop:
+        initial_step = 0
+        steps_per_call = 200
+
+    hook = ProfilerHook(str(tmp_path), start_step=10, num_steps=3)
+    hook.begin(ChunkedLoop())
+    hook.before_step(0)
+    assert hook._active  # window aligned to chunk boundary 0
+    x = jnp.ones((64, 64))
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    hook.after_step(200, None, {"loss": x[0, 0]})
+    assert not hook._active
+    assert latest_trace(tmp_path) is not None
+
+
 def test_summarize_synthetic_trace(tmp_path):
     """Deterministic check of aggregation math on a hand-written trace."""
     trace = {
