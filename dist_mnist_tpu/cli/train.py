@@ -156,6 +156,23 @@ def run_config(
     )
 
     t0 = time.monotonic()
+    # flag-combination errors fail BEFORE any expensive work (dataset load,
+    # init, restore) — decidable from the arguments alone
+    if scan_chunk and not input_pipeline.startswith("device"):
+        raise ValueError(
+            "--scan_chunk needs an in-program input path "
+            "(--input_pipeline=device|device_sharded): a host batcher "
+            "cannot feed a compiled multi-step scan"
+        )
+    if scan_chunk and cfg.train_steps % scan_chunk:
+        stop_at = -(-cfg.train_steps // scan_chunk) * scan_chunk
+        log.warning(
+            "train_steps=%d is not a multiple of scan_chunk=%d: the "
+            "loop stops at the chunk boundary, step %d (%d extra "
+            "steps, past the LR schedule horizon)",
+            cfg.train_steps, scan_chunk, stop_at,
+            stop_at - cfg.train_steps,
+        )
     mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
     dataset = load_dataset(cfg.dataset, data_dir, seed=cfg.seed)
     model = get_model(cfg.model, **cfg.model_kwargs)
@@ -184,21 +201,6 @@ def run_config(
             cfg.name, cfg.model, jax.device_count(), restored,
         )
 
-        if scan_chunk and not input_pipeline.startswith("device"):
-            raise ValueError(
-                "--scan_chunk needs an in-program input path "
-                "(--input_pipeline=device|device_sharded): a host batcher "
-                "cannot feed a compiled multi-step scan"
-            )
-        if scan_chunk and cfg.train_steps % scan_chunk:
-            stop_at = -(-cfg.train_steps // scan_chunk) * scan_chunk
-            log.warning(
-                "train_steps=%d is not a multiple of scan_chunk=%d: the "
-                "loop stops at the chunk boundary, step %d (%d extra "
-                "steps, past the LR schedule horizon)",
-                cfg.train_steps, scan_chunk, stop_at,
-                stop_at - cfg.train_steps,
-            )
         if input_pipeline.startswith("device"):
             # input fused into the program (train/step.py): the dataset
             # lives in HBM and each step samples on-device — no feed at

@@ -101,6 +101,7 @@ CONFIGS = {
         weight_decay=0.05,
         remat=True,  # depth-12 attention stack: recompute, don't hold
         augment=True,
+        model_kwargs={"scan_blocks": True},  # one compiled block, not 12
         mesh=MeshSpec(data=-1),  # whole slice
     ),
     # 5b) config 5 with Ulysses sequence parallelism (SURVEY.md §5.7): the
@@ -120,8 +121,29 @@ CONFIGS = {
         weight_decay=0.05,
         remat=True,
         augment=True,
-        model_kwargs={"attention_impl": "ulysses", "pool": "mean", "heads": 4},
+        model_kwargs={"attention_impl": "ulysses", "pool": "mean",
+                      "heads": 4, "scan_blocks": True},
         mesh=MeshSpec(data=-1, seq=2),
+    ),
+    # 5c) config 5 with switch-MoE FFN blocks, expert-parallel over a
+    # 4-way `model` axis (one expert per rank — parallel/moe.py); the
+    # load-balance aux loss joins the objective via model_state.
+    "vit_tiny_cifar_moe": Config(
+        name="vit_tiny_cifar_moe",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"mlp_impl": "moe", "n_experts": 4, "pool": "mean",
+                      "scan_blocks": True},
+        mesh=MeshSpec(data=-1, model=4),
     ),
 }
 

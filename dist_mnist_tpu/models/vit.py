@@ -34,6 +34,18 @@ class ViTTiny:
     attention_impl: str = "xla"  # "xla" | "flash" | "ring" | "ulysses"
     pool: str = "cls"  # "cls" | "mean" (mean keeps token count a power of
     # two — required when the sequence dim is sharded, e.g. ring attention)
+    mlp_impl: str = "dense"  # "dense" | "moe" (switch-routed expert FFN,
+    # expert-parallel over the `model` axis when it matches n_experts —
+    # parallel/moe.py)
+    n_experts: int = 4
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2  # load-balance loss weight (Switch form);
+    # the train step adds state["moe_aux"] to the loss
+    scan_blocks: bool = False  # compile ONE block and lax.scan over stacked
+    # per-layer params instead of unrolling `depth` copies of the program —
+    # ~depth x less HLO to build/compile, identical numerics. The required
+    # idiom for deep stacks under XLA; off by default only so per-block
+    # param paths (block0/...) stay addressable by older sharding rules.
 
     def init(self, rng, sample_input):
         h, w, c = (int(d) for d in sample_input.shape[1:])
@@ -52,18 +64,37 @@ class ViTTiny:
         }
         if self.pool == "cls":
             params["cls"] = jnp.zeros((1, 1, d))
+        blocks = []
         for i in range(self.depth):
             k1, k2, k3 = jax.random.split(keys[3 + i], 3)
-            params[f"block{i}"] = {
+            block = {
                 "ln1": nn.init_layer_norm(d),
                 "attn": nn.init_attention(k1, d, self.heads),
                 "ln2": nn.init_layer_norm(d),
-                "mlp_in": nn.init_dense(k2, d, d * self.mlp_ratio,
-                                        init=nn.xavier_uniform),
-                "mlp_out": nn.init_dense(k3, d * self.mlp_ratio, d,
-                                         init=nn.xavier_uniform),
             }
-        return params, {}
+            if self.mlp_impl == "moe":
+                from dist_mnist_tpu.parallel.moe import init_moe
+
+                block["moe"] = init_moe(k2, d, d * self.mlp_ratio,
+                                        self.n_experts)
+            else:
+                block["mlp_in"] = nn.init_dense(k2, d, d * self.mlp_ratio,
+                                                init=nn.xavier_uniform)
+                block["mlp_out"] = nn.init_dense(k3, d * self.mlp_ratio, d,
+                                                 init=nn.xavier_uniform)
+            blocks.append(block)
+        if self.scan_blocks:
+            # one stacked pytree ([depth, ...] leaves) scanned by apply;
+            # per-block init is identical to the unrolled layout, so the
+            # two layouts are numerically interchangeable (stack/unstack)
+            params["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+        else:
+            for i, block in enumerate(blocks):
+                params[f"block{i}"] = block
+        # state carries the load-balance aux loss so the train step can add
+        # it to the objective (structure must match apply's output)
+        state = {"moe_aux": jnp.zeros(())} if self.mlp_impl == "moe" else {}
+        return params, state
 
     def _attention(self, p, x):
         if self.attention_impl == "xla":
@@ -91,6 +122,28 @@ class ViTTiny:
             )
         return nn.dense(p["out"], out.reshape(b, s, d))
 
+    def _block(self, p, x, layer_rng, use_dropout):
+        """One pre-LN transformer block; returns (x, moe_aux)."""
+        y = nn.layer_norm(p["ln1"], x)
+        x = x + self._attention(p["attn"], y)
+        y = nn.layer_norm(p["ln2"], x)
+        aux = jnp.zeros((), jnp.float32)
+        if self.mlp_impl == "moe":
+            from dist_mnist_tpu.parallel.moe import moe_ffn_adaptive
+
+            bb, ss, dd = y.shape
+            y, aux = moe_ffn_adaptive(
+                p["moe"], y.reshape(bb * ss, dd),
+                capacity_factor=self.moe_capacity_factor,
+            )
+            y = y.reshape(bb, ss, dd)
+        else:
+            y = nn.gelu(nn.dense(p["mlp_in"], y))
+        if use_dropout:
+            y = nn.dropout(layer_rng, y, self.dropout_rate, train=True)
+        x = x + (y if self.mlp_impl == "moe" else nn.dense(p["mlp_out"], y))
+        return x, aux
+
     def apply(self, params, state, x, *, train=False, rng=None):
         x = x.astype(self.compute_dtype)
         x = nn.conv2d(params["patch"], x, stride=self.patch, padding="VALID")
@@ -100,18 +153,29 @@ class ViTTiny:
             cls = jnp.broadcast_to(params["cls"].astype(x.dtype), (b, 1, d))
             x = jnp.concatenate([cls, x], axis=1)
         x = x + params["pos"].astype(x.dtype)
-        if train and rng is not None:
-            rngs = jax.random.split(rng, self.depth)
-        for i in range(self.depth):
-            p = params[f"block{i}"]
-            y = nn.layer_norm(p["ln1"], x)
-            x = x + self._attention(p["attn"], y)
-            y = nn.layer_norm(p["ln2"], x)
-            y = nn.gelu(nn.dense(p["mlp_in"], y))
-            if train and rng is not None:
-                y = nn.dropout(rngs[i], y, self.dropout_rate, train=True)
-            x = x + nn.dense(p["mlp_out"], y)
+        use_dropout = train and rng is not None
+        rngs = (jax.random.split(rng, self.depth) if use_dropout
+                else jnp.zeros((self.depth,)))  # scannable dummy
+        if self.scan_blocks:
+            def body(carry, xs):
+                x, aux_total = carry
+                p, layer_rng = xs
+                x, aux = self._block(p, x, layer_rng, use_dropout)
+                return (x, aux_total + aux), None
+
+            (x, aux_total), _ = jax.lax.scan(
+                body, (x, jnp.zeros((), jnp.float32)),
+                (params["blocks"], rngs),
+            )
+        else:
+            aux_total = jnp.zeros((), jnp.float32)
+            for i in range(self.depth):
+                x, aux = self._block(params[f"block{i}"], x, rngs[i],
+                                     use_dropout)
+                aux_total = aux_total + aux
         x = nn.layer_norm(params["final_ln"], x)
         pooled = x[:, 0] if self.pool == "cls" else jnp.mean(x, axis=1)
         logits = nn.dense(params["head"], pooled)
+        if self.mlp_impl == "moe":
+            state = {"moe_aux": self.moe_aux_weight * aux_total / self.depth}
         return logits.astype(jnp.float32), state

@@ -39,7 +39,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P, get_abstract_mesh
 
 from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
 from dist_mnist_tpu.ops.nn import fan_in_trunc_normal
@@ -134,6 +134,30 @@ def moe_ffn_inner(params, x, axis_name: str = MODEL_AXIS,
     )
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
     return out.astype(x.dtype), aux
+
+
+def moe_ffn_adaptive(params, x, capacity_factor: float = 1.25):
+    """Mesh-adaptive entry used by models (mirrors ring/ulysses attention):
+    expert-parallel over the ambient mesh's `model` axis when it is >1 AND
+    matches the expert count, else the dense-local oracle — the same model
+    code runs on any mesh. x: [T, D] tokens. An expert-count/axis MISMATCH
+    on a real model axis falls back dense too, but loudly: the user asked
+    for expert parallelism and isn't getting it."""
+    import logging
+
+    mesh = get_abstract_mesh()
+    e = params["gate"].shape[-1]
+    axis = (getattr(mesh, "shape", {}) or {}).get(MODEL_AXIS, 1) if mesh else 1
+    if axis != e:
+        if axis > 1:
+            logging.getLogger(__name__).warning(
+                "moe_ffn_adaptive: n_experts=%d != model axis %d — running "
+                "DENSE (all experts local, no all_to_all dispatch); size "
+                "the model axis to the expert count for expert parallelism",
+                e, axis,
+            )
+        return moe_ffn_dense(params, x, capacity_factor)
+    return moe_ffn(params, x, mesh, MODEL_AXIS, capacity_factor)
 
 
 def moe_ffn(params, x, mesh: Mesh, axis_name: str = MODEL_AXIS,

@@ -72,7 +72,13 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
 
     def loss_of(params):
         logits, new_model_state = forward(params, state.model_state, x)
-        return loss_fn(logits, y), (logits, new_model_state)
+        loss = loss_fn(logits, y)
+        # auxiliary objectives the model emits (e.g. the MoE load-balance
+        # loss, models/vit.py) ride in model_state and join the loss HERE,
+        # inside the grad — already weighted by the model
+        if isinstance(new_model_state, dict) and "moe_aux" in new_model_state:
+            loss = loss + new_model_state["moe_aux"]
+        return loss, (logits, new_model_state)
 
     (loss, (logits, new_model_state)), grads = jax.value_and_grad(
         loss_of, has_aux=True
