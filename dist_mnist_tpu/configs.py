@@ -145,6 +145,26 @@ CONFIGS = {
                       "scan_blocks": True},
         mesh=MeshSpec(data=-1, model=4),
     ),
+    # 5d) config 5 with the block stack GPipe'd over a 4-stage `pipe` axis
+    # (3 blocks per stage, microbatched activations around the ICI ring —
+    # parallel/pipeline.py). Dropout-free: stage fns carry no rng.
+    "vit_tiny_cifar_pp": Config(
+        name="vit_tiny_cifar_pp",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"scan_blocks": True, "block_pipeline": 4,
+                      "dropout_rate": 0.0},
+        mesh=MeshSpec(data=-1, pipe=4),
+    ),
 }
 
 

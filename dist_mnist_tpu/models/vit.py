@@ -21,6 +21,34 @@ import jax.numpy as jnp
 from dist_mnist_tpu.ops import nn
 
 
+def convert_block_layout(params: dict) -> dict:
+    """Convert a ViT param tree between the unrolled layout
+    (``block0..blockN-1``) and the scanned layout (stacked ``blocks``) —
+    whichever it has, you get the other. The layouts are numerically
+    interchangeable, so a checkpoint written before flipping
+    ``scan_blocks`` restores after a pass through this converter."""
+    import re
+
+    if "blocks" in params:
+        out = {k: v for k, v in params.items() if k != "blocks"}
+        stacked = params["blocks"]
+        depth = jax.tree.leaves(stacked)[0].shape[0]
+        for i in range(depth):
+            out[f"block{i}"] = jax.tree.map(lambda a, i=i: a[i], stacked)
+        return out
+    block_keys = sorted(
+        (k for k in params if re.fullmatch(r"block\d+", k)),
+        key=lambda k: int(k[5:]),
+    )
+    if not block_keys:
+        raise ValueError("no block0.. or 'blocks' entry to convert")
+    from dist_mnist_tpu.parallel.pipeline import stack_stage_params
+
+    out = {k: v for k, v in params.items() if k not in block_keys}
+    out["blocks"] = stack_stage_params([params[k] for k in block_keys])
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class ViTTiny:
     num_classes: int = 10
@@ -46,6 +74,13 @@ class ViTTiny:
     # ~depth x less HLO to build/compile, identical numerics. The required
     # idiom for deep stacks under XLA; off by default only so per-block
     # param paths (block0/...) stay addressable by older sharding rules.
+    block_pipeline: int = 0  # N>0: shard the block stack into N GPipe
+    # stages over the `pipe` mesh axis (parallel/pipeline.py). Needs
+    # scan_blocks (stacked layout), depth % N == 0, dropout_rate == 0
+    # (stage fns carry no rng), dense MLP. Engages only when the ambient
+    # mesh's pipe axis equals N; on any other mesh the same model falls
+    # back to the plain scan — one model, any topology.
+    pipeline_microbatches: int = 8  # GPipe M; bubble = (N-1)/(M+N-1)
 
     def init(self, rng, sample_input):
         h, w, c = (int(d) for d in sample_input.shape[1:])
@@ -86,8 +121,11 @@ class ViTTiny:
         if self.scan_blocks:
             # one stacked pytree ([depth, ...] leaves) scanned by apply;
             # per-block init is identical to the unrolled layout, so the
-            # two layouts are numerically interchangeable (stack/unstack)
-            params["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+            # two layouts are numerically interchangeable
+            # (convert_block_layout moves checkpoints between them)
+            from dist_mnist_tpu.parallel.pipeline import stack_stage_params
+
+            params["blocks"] = stack_stage_params(blocks)
         else:
             for i, block in enumerate(blocks):
                 params[f"block{i}"] = block
@@ -144,6 +182,68 @@ class ViTTiny:
         x = x + (y if self.mlp_impl == "moe" else nn.dense(p["mlp_out"], y))
         return x, aux
 
+    def _pipe_axis_live(self) -> bool:
+        from jax.sharding import get_abstract_mesh
+
+        from dist_mnist_tpu.cluster.mesh import PIPE_AXIS
+
+        mesh = get_abstract_mesh()
+        shape = getattr(mesh, "shape", {}) if mesh is not None else {}
+        return shape.get(PIPE_AXIS, 1) > 1
+
+    def _pipelined_blocks(self, params, x, use_dropout):
+        """GPipe the block stack over the `pipe` mesh axis: stage s runs
+        blocks [s*depth/N, (s+1)*depth/N) as an inner scan; activations
+        flow stage->stage via ppermute (parallel/pipeline.py)."""
+        from jax.sharding import get_abstract_mesh
+
+        from dist_mnist_tpu.cluster.mesh import PIPE_AXIS
+        from dist_mnist_tpu.parallel.pipeline import pipeline_apply
+
+        mesh = get_abstract_mesh()
+        n = mesh.shape[PIPE_AXIS]
+        if n != self.block_pipeline:
+            raise ValueError(
+                f"block_pipeline={self.block_pipeline} != pipe axis {n}"
+            )
+        if not self.scan_blocks or self.depth % n:
+            raise ValueError(
+                "block_pipeline needs scan_blocks=True and depth % stages == 0"
+            )
+        if use_dropout:
+            raise ValueError(
+                "the pipeline path runs dropout-free (stage fns carry no "
+                "rng); set dropout_rate=0"
+            )
+        if self.mlp_impl == "moe":
+            raise ValueError("block_pipeline supports dense MLP blocks only")
+        per_stage = self.depth // n
+        stage_params = jax.tree.map(
+            lambda a: a.reshape((n, per_stage) + a.shape[1:]),
+            params["blocks"],
+        )
+
+        def stage_fn(p, xx):
+            def body(carry, pp):
+                out, _ = self._block(pp, carry, None, False)
+                return out, None
+
+            out, _ = jax.lax.scan(body, xx, p)
+            return out
+
+        # GPipe output is independent of M, so adapt M down to the largest
+        # count this batch supports (B % M == 0 and the per-microbatch rows
+        # divisible by the data axis) — e.g. eval batches differ from the
+        # train batch and must not have to know the model's M
+        from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+
+        b = x.shape[0]
+        data_axis = mesh.shape.get(DATA_AXIS, 1)
+        m = min(self.pipeline_microbatches, b)
+        while m > 1 and (b % m or (b // m) % data_axis):
+            m -= 1
+        return pipeline_apply(stage_fn, stage_params, x, m, mesh)
+
     def apply(self, params, state, x, *, train=False, rng=None):
         x = x.astype(self.compute_dtype)
         x = nn.conv2d(params["patch"], x, stride=self.patch, padding="VALID")
@@ -153,10 +253,13 @@ class ViTTiny:
             cls = jnp.broadcast_to(params["cls"].astype(x.dtype), (b, 1, d))
             x = jnp.concatenate([cls, x], axis=1)
         x = x + params["pos"].astype(x.dtype)
-        use_dropout = train and rng is not None
+        use_dropout = train and rng is not None and self.dropout_rate > 0
         rngs = (jax.random.split(rng, self.depth) if use_dropout
                 else jnp.zeros((self.depth,)))  # scannable dummy
-        if self.scan_blocks:
+        if self.block_pipeline and self._pipe_axis_live():
+            x = self._pipelined_blocks(params, x, use_dropout)
+            aux_total = jnp.zeros((), jnp.float32)
+        elif self.scan_blocks:
             def body(carry, xs):
                 x, aux_total = carry
                 p, layer_rng = xs

@@ -72,7 +72,15 @@ def make_explicit_dp_step(model, optimizer, mesh: Mesh, *, loss_fn=None):
             logits, new_ms = model.apply(
                 params, state.model_state, x, train=True, rng=step_key
             )
-            return loss_fn(logits, y), (logits, new_ms)
+            loss = loss_fn(logits, y)
+            # same aux-objective contract as the GSPMD core — the two step
+            # implementations must train the same objective
+            from dist_mnist_tpu.train.step import model_aux_loss
+
+            aux = model_aux_loss(new_ms)
+            if aux is not None:
+                loss = loss + aux
+            return loss, (logits, new_ms)
 
         (loss, (logits, new_ms)), grads = jax.value_and_grad(
             loss_of, has_aux=True

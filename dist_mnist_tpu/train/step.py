@@ -29,6 +29,22 @@ from dist_mnist_tpu.train.state import TrainState
 LossFn = Callable[..., jax.Array]
 
 
+def model_aux_loss(model_state):
+    """THE aux-objective contract: any top-level SCALAR entry of
+    `model_state` whose key ends in ``_aux`` (e.g. the MoE load-balance
+    term ``moe_aux``, models/vit.py) is an auxiliary loss the model wants
+    added to the training objective, already weighted by the model. Every
+    step implementation (the GSPMD core here AND
+    parallel/collectives.make_explicit_dp_step) sums aux terms through
+    this one helper so the objectives cannot silently diverge. Returns
+    None when there are none."""
+    if not isinstance(model_state, dict):
+        return None
+    terms = [v for k, v in model_state.items()
+             if k.endswith("_aux") and getattr(v, "ndim", None) == 0]
+    return sum(terms[1:], terms[0]) if terms else None
+
+
 def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
                 dropout_key, *, with_grad_norm: bool = False,
                 remat: bool = False, augment: bool = False):
@@ -73,11 +89,11 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
     def loss_of(params):
         logits, new_model_state = forward(params, state.model_state, x)
         loss = loss_fn(logits, y)
-        # auxiliary objectives the model emits (e.g. the MoE load-balance
-        # loss, models/vit.py) ride in model_state and join the loss HERE,
-        # inside the grad — already weighted by the model
-        if isinstance(new_model_state, dict) and "moe_aux" in new_model_state:
-            loss = loss + new_model_state["moe_aux"]
+        # auxiliary objectives the model emits ride in model_state and
+        # join the loss HERE, inside the grad (contract: model_aux_loss)
+        aux = model_aux_loss(new_model_state)
+        if aux is not None:
+            loss = loss + aux
         return loss, (logits, new_model_state)
 
     (loss, (logits, new_model_state)), grads = jax.value_and_grad(

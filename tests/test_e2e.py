@@ -14,7 +14,7 @@ def test_config_registry_covers_ladder():
     assert set(CONFIGS) == {
         "mlp_mnist", "lenet5_mnist", "lenet5_fashion",
         "resnet20_cifar", "vit_tiny_cifar", "vit_tiny_cifar_ulysses",
-        "vit_tiny_cifar_moe",
+        "vit_tiny_cifar_moe", "vit_tiny_cifar_pp",
     }
 
 

@@ -128,3 +128,29 @@ def test_vit_scan_blocks_matches_unrolled(rng):
     np.testing.assert_allclose(np.asarray(gl["head"]["w"]),
                                np.asarray(gs["head"]["w"]),
                                rtol=2e-4, atol=1e-5)
+
+
+def test_vit_block_layout_converter(rng):
+    """convert_block_layout round-trips and moves a pre-scan_blocks
+    checkpoint tree into the stacked layout (and back)."""
+    from dist_mnist_tpu.models.vit import convert_block_layout
+
+    kwargs = dict(depth=3, dim=32, heads=4, patch=8, compute_dtype=jnp.float32)
+    loop_model = get_model("vit_tiny", **kwargs)
+    scan_model = get_model("vit_tiny", scan_blocks=True, **kwargs)
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    lp, ls = loop_model.init(rng, x)
+    sp, _ = scan_model.init(rng, x)
+
+    converted = convert_block_layout(lp)  # unrolled -> stacked
+    assert jax.tree.structure(converted) == jax.tree.structure(sp)
+    for a, b in zip(jax.tree.leaves(converted), jax.tree.leaves(sp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # and the converted tree actually runs in the scan model
+    out_scan, _ = scan_model.apply(converted, ls, x, train=False)
+    out_loop, _ = loop_model.apply(lp, ls, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop),
+                               rtol=1e-5, atol=1e-6)
+    # round-trip back to unrolled
+    back = convert_block_layout(converted)
+    assert jax.tree.structure(back) == jax.tree.structure(lp)

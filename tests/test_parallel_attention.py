@@ -227,3 +227,43 @@ def test_explicit_dp_step_matches_gspmd(mesh8):
                                rtol=2e-4, atol=2e-6)
     np.testing.assert_allclose(results["gspmd"][1], results["explicit"][1],
                                rtol=2e-4)
+
+
+def test_explicit_dp_step_matches_gspmd_with_aux(mesh8):
+    """The two step implementations must train the SAME objective for an
+    aux-emitting model (MoE load-balance term — model_aux_loss contract):
+    identical loss and identical post-step router-gate weights."""
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.data.pipeline import shard_batch
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.parallel.collectives import make_explicit_dp_step
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state, make_train_step
+
+    model = get_model("vit_tiny", depth=1, dim=32, heads=4, patch=8,
+                      pool="mean", mlp_impl="moe", n_experts=2,
+                      moe_capacity_factor=8.0, dropout_rate=0.0,
+                      compute_dtype=jnp.float32)
+    rng = np.random.default_rng(21)
+    batch_np = {
+        "image": rng.integers(0, 255, (16, 32, 32, 3), dtype=np.uint8),
+        "label": rng.integers(0, 10, (16,), dtype=np.int32),
+    }
+    results = {}
+    for name, maker in (
+        ("gspmd", lambda m, o: make_train_step(model, o, m, donate=False)),
+        ("explicit", lambda m, o: make_explicit_dp_step(model, o, m)),
+    ):
+        opt = optim.adam(0.01)
+        with mesh8:
+            state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                       batch_np["image"][:1])
+            state = shard_train_state(state, mesh8)
+            step = maker(mesh8, opt)
+            state, out = step(state, shard_batch(batch_np, mesh8))
+        results[name] = (float(out["loss"]),
+                         np.asarray(state.params["block0"]["moe"]["gate"]))
+    np.testing.assert_allclose(results["gspmd"][0], results["explicit"][0],
+                               rtol=2e-5)
+    np.testing.assert_allclose(results["gspmd"][1], results["explicit"][1],
+                               rtol=2e-4, atol=2e-6)
