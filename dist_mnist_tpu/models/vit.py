@@ -182,14 +182,29 @@ class ViTTiny:
         x = x + (y if self.mlp_impl == "moe" else nn.dense(p["mlp_out"], y))
         return x, aux
 
-    def _pipe_axis_live(self) -> bool:
+    def _pipe_axis_matches(self) -> bool:
+        """True only when the ambient mesh's pipe axis equals the
+        configured stage count; a >1-but-mismatched axis falls back to the
+        plain scan (one model, any topology), loudly."""
+        import logging
+
         from jax.sharding import get_abstract_mesh
 
         from dist_mnist_tpu.cluster.mesh import PIPE_AXIS
 
         mesh = get_abstract_mesh()
         shape = getattr(mesh, "shape", {}) if mesh is not None else {}
-        return shape.get(PIPE_AXIS, 1) > 1
+        axis = shape.get(PIPE_AXIS, 1)
+        if axis == self.block_pipeline:
+            return True
+        if axis > 1:
+            logging.getLogger(__name__).warning(
+                "block_pipeline=%d != pipe axis %d — running the plain "
+                "scanned stack (no pipeline); size the pipe axis to the "
+                "stage count for pipeline parallelism",
+                self.block_pipeline, axis,
+            )
+        return False
 
     def _pipelined_blocks(self, params, x, use_dropout):
         """GPipe the block stack over the `pipe` mesh axis: stage s runs
@@ -202,10 +217,6 @@ class ViTTiny:
 
         mesh = get_abstract_mesh()
         n = mesh.shape[PIPE_AXIS]
-        if n != self.block_pipeline:
-            raise ValueError(
-                f"block_pipeline={self.block_pipeline} != pipe axis {n}"
-            )
         if not self.scan_blocks or self.depth % n:
             raise ValueError(
                 "block_pipeline needs scan_blocks=True and depth % stages == 0"
@@ -256,7 +267,7 @@ class ViTTiny:
         use_dropout = train and rng is not None and self.dropout_rate > 0
         rngs = (jax.random.split(rng, self.depth) if use_dropout
                 else jnp.zeros((self.depth,)))  # scannable dummy
-        if self.block_pipeline and self._pipe_axis_live():
+        if self.block_pipeline and self._pipe_axis_matches():
             x = self._pipelined_blocks(params, x, use_dropout)
             aux_total = jnp.zeros((), jnp.float32)
         elif self.scan_blocks:

@@ -73,8 +73,16 @@ def make_explicit_dp_step(model, optimizer, mesh: Mesh, *, loss_fn=None):
                 params, state.model_state, x, train=True, rng=step_key
             )
             loss = loss_fn(logits, y)
-            # same aux-objective contract as the GSPMD core — the two step
-            # implementations must train the same objective
+            # same aux-objective CONTRACT as the GSPMD core (train/step.py
+            # model_aux_loss). Note the semantics difference for
+            # batch-statistic auxes like MoE's load balance: here the model
+            # runs per-shard, so routing/capacity and aux are computed on
+            # each shard's tokens and the pmean below averages the
+            # per-shard estimates — the standard local-routing DP-MoE
+            # choice. The GSPMD step routes over the GLOBAL batch. The two
+            # agree when capacity is generous (no drops) and the router is
+            # balanced; at tight capacity they are different (both valid)
+            # estimators of the Switch objective.
             from dist_mnist_tpu.train.step import model_aux_loss
 
             aux = model_aux_loss(new_ms)
