@@ -146,7 +146,11 @@ def run_config(
     from dist_mnist_tpu.models import get_model
     from dist_mnist_tpu.obs import make_default_writer
     from dist_mnist_tpu.ops import losses
-    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.parallel.sharding import (
+        DP_RULES,
+        TP_RULES,
+        shard_train_state,
+    )
     from dist_mnist_tpu.train import (
         TrainLoop,
         create_train_state,
@@ -164,6 +168,11 @@ def run_config(
             "(--input_pipeline=device|device_sharded): a host batcher "
             "cannot feed a compiled multi-step scan"
         )
+    if cfg.sharding_rules not in ("dp", "tp"):
+        raise ValueError(
+            f"unknown sharding_rules {cfg.sharding_rules!r}; use 'dp' | 'tp'"
+        )
+    rules = {"dp": DP_RULES, "tp": TP_RULES}[cfg.sharding_rules]
     if scan_chunk and cfg.train_steps % scan_chunk:
         stop_at = -(-cfg.train_steps // scan_chunk) * scan_chunk
         log.warning(
@@ -189,7 +198,7 @@ def run_config(
     # (ring/ulysses discover the seq axis via the ABSTRACT mesh) engages
     with activate(mesh):
         state = create_train_state(model, optimizer, rng, sample)
-        state = shard_train_state(state, mesh)
+        state = shard_train_state(state, mesh, rules)
 
         manager = None
         restored = False
@@ -219,17 +228,20 @@ def run_config(
             if scan_chunk:
                 run = make_scanned_train_fn(
                     model, optimizer, mesh, dd, cfg.batch_size, scan_chunk,
-                    loss_fn=loss_fn, remat=cfg.remat, augment=cfg.augment,
+                    loss_fn=loss_fn, rules=rules, remat=cfg.remat,
+                    augment=cfg.augment,
                 )
             else:
                 run = make_fused_train_step(
                     model, optimizer, mesh, dd, cfg.batch_size,
-                    loss_fn=loss_fn, remat=cfg.remat, augment=cfg.augment,
+                    loss_fn=loss_fn, rules=rules, remat=cfg.remat,
+                    augment=cfg.augment,
                 )
             step_fn = lambda state, _batch: run(state)
         else:
             step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn,
-                                      remat=cfg.remat, augment=cfg.augment)
+                                      rules=rules, remat=cfg.remat,
+                                      augment=cfg.augment)
         eval_step = make_eval_step(model, mesh)
         eval_fn = lambda s: evaluate(
             eval_step, s, dataset.test_images, dataset.test_labels, mesh

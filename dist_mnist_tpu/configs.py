@@ -30,6 +30,8 @@ class Config:
     lr_schedule: str = "constant"  # constant | cosine
     warmup_steps: int = 0
     replicas_to_aggregate: int = 1  # >1 => gradient accumulation (optim/sync.py)
+    sharding_rules: str = "dp"  # "dp" (params replicated) | "tp" (Megatron
+    # column/row TP_RULES over the `model` axis — parallel/sharding.py)
     grad_clip_norm: float | None = None
     weight_decay: float = 0.0
     remat: bool = False  # jax.checkpoint the forward (HBM <-> FLOPs trade)
@@ -144,6 +146,45 @@ CONFIGS = {
         model_kwargs={"mlp_impl": "moe", "n_experts": 4, "pool": "mean",
                       "scan_blocks": True},
         mesh=MeshSpec(data=-1, model=4),
+    ),
+    # 5e) config 5 tensor-parallel: qkv/mlp matmuls Megatron-sharded over a
+    # 2-way `model` axis (TP_RULES column/row pattern); grads for the
+    # sharded params stay sharded — XLA inserts the TP reduce in-step.
+    "vit_tiny_cifar_tp": Config(
+        name="vit_tiny_cifar_tp",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"scan_blocks": True},
+        sharding_rules="tp",
+        mesh=MeshSpec(data=-1, model=2),
+    ),
+    # 5f) config 5 with ring attention over a 2-way `seq` axis (blockwise
+    # K/V rotation around the ICI ring — parallel/ring_attention.py).
+    "vit_tiny_cifar_ring": Config(
+        name="vit_tiny_cifar_ring",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"attention_impl": "ring", "pool": "mean",
+                      "scan_blocks": True},
+        mesh=MeshSpec(data=-1, seq=2),
     ),
     # 5d) config 5 with the block stack GPipe'd over a 4-stage `pipe` axis
     # (3 blocks per stage, microbatched activations around the ICI ring —

@@ -195,7 +195,9 @@ class ViTTiny:
         mesh = get_abstract_mesh()
         shape = getattr(mesh, "shape", {}) if mesh is not None else {}
         axis = shape.get(PIPE_AXIS, 1)
-        if axis == self.block_pipeline:
+        # axis > 1 required: a singleton/absent pipe axis always means the
+        # plain scan, even for block_pipeline=1 (there is nothing to pipe)
+        if axis > 1 and axis == self.block_pipeline:
             return True
         if axis > 1:
             logging.getLogger(__name__).warning(

@@ -356,7 +356,7 @@ class TestPipelineInViT:
         gb = np.asarray(jnp.abs(g["blocks"]["attn"]["qkv"]["w"]).sum(axis=(1, 2)))
         assert (gb > 0).all(), gb
 
-    def test_pipeline_guards(self):
+    def test_pipeline_guards(self, caplog):
         from dist_mnist_tpu.cluster.mesh import activate
         from dist_mnist_tpu.models import get_model
 
@@ -378,19 +378,15 @@ class TestPipelineInViT:
         model = get_model("vit_tiny", block_pipeline=4, **self.KW)
         params, state = model.init(jax.random.PRNGKey(0), x)
         ref, _ = model.apply(params, state, x, train=False)  # no mesh: scan
-        caplog_records = []
-
-        class _Catch(logging.Handler):
-            def emit(self, record):
-                caplog_records.append(record.getMessage())
-
-        handler = _Catch()
-        logging.getLogger("dist_mnist_tpu.models.vit").addHandler(handler)
-        try:
+        with caplog.at_level(logging.WARNING,
+                                   logger="dist_mnist_tpu.models.vit"):
             with activate(mesh):
                 out, _ = model.apply(params, state, x, train=False)
-        finally:
-            logging.getLogger("dist_mnist_tpu.models.vit").removeHandler(handler)
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                    rtol=2e-4, atol=2e-5)
-        assert any("pipe axis" in m for m in caplog_records)
+        assert any("pipe axis" in r.message for r in caplog.records)
+        # block_pipeline=1 off any pipe mesh is just the scan (no KeyError)
+        m1 = get_model("vit_tiny", block_pipeline=1, **self.KW)
+        p1, s1 = m1.init(jax.random.PRNGKey(0), x)
+        out1, _ = m1.apply(p1, s1, x, train=False)
+        assert np.isfinite(np.asarray(out1)).all()
