@@ -140,6 +140,22 @@ def _mfu_fields(run, state, dt_per_step: float):
     }
 
 
+def ladder_batch(cfg, n_chips: int) -> tuple[int, str]:
+    """Global batch to run a ladder config with on `n_chips`.
+
+    A config's batch_size is sized for `cfg.ladder_devices` chips; on a
+    smaller box the PER-CHIP batch (the steps/sec/chip-relevant quantity)
+    is preserved instead of cramming the pod-slice batch into one chip's
+    HBM (measured: vit_tiny_cifar's batch-1024 step needs 19.4G vs the
+    v5e's 15.75G). Returns (batch, provenance_note)."""
+    if n_chips < cfg.ladder_devices:
+        per_chip = max(1, cfg.batch_size // cfg.ladder_devices)
+        return per_chip * n_chips, (
+            f"per-chip geometry of the {cfg.ladder_devices}-chip ladder "
+            f"config: {per_chip}/chip x {n_chips} chips")
+    return cfg.batch_size, "config global batch"
+
+
 def bench_config(name: str, n_timed: int) -> int:
     """Steady-state throughput + MFU for one ladder config (no accuracy
     race — only the headline MNIST config has a published accuracy target).
@@ -157,11 +173,13 @@ def bench_config(name: str, n_timed: int) -> int:
     from dist_mnist_tpu.data import DeviceDataset, load_dataset
     from dist_mnist_tpu.models import get_model
     from dist_mnist_tpu.ops import losses
-    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.parallel.sharding import resolve_rules, shard_train_state
     from dist_mnist_tpu.train import create_train_state
     from dist_mnist_tpu.train.step import make_scanned_train_fn
+    from dist_mnist_tpu.utils.timing import timed_chunks
 
     cfg = get_config(name)
+    rules = resolve_rules(cfg.sharding_rules)  # a TP config benches TP
     try:
         mesh = make_mesh(cfg.mesh)  # the config's declared topology
         mesh_note = "config"
@@ -170,6 +188,7 @@ def bench_config(name: str, n_timed: int) -> int:
         mesh = make_mesh(MeshSpec(data=-1))
         mesh_note = f"fallback (config wants {cfg.mesh}, have {jax.device_count()})"
     n_chips = mesh.devices.size
+    global_batch, batch_note = ladder_batch(cfg, n_chips)
     dataset = load_dataset(cfg.dataset, "/tmp/mnist-data", seed=cfg.seed)
     model = get_model(cfg.model, **cfg.model_kwargs)
     optimizer = build_optimizer(cfg)
@@ -180,18 +199,14 @@ def bench_config(name: str, n_timed: int) -> int:
         state = create_train_state(
             model, optimizer, jax.random.PRNGKey(0), dataset.train_images[:1]
         )
-        state = shard_train_state(state, mesh)
+        state = shard_train_state(state, mesh, rules)
         dd = DeviceDataset(dataset, mesh)
         run = make_scanned_train_fn(model, optimizer, mesh, dd,
-                                    cfg.batch_size, chunk, loss_fn=loss_fn,
+                                    global_batch, chunk, loss_fn=loss_fn,
+                                    rules=rules,
                                     remat=cfg.remat, augment=cfg.augment)
-        state, out = run(state)  # compile + warmup
-        jax.block_until_ready(out["loss"])
-        t0 = time.monotonic()
-        for _ in range(max(1, n_timed // chunk)):
-            state, out = run(state)
-        jax.block_until_ready(out["loss"])
-        dt = time.monotonic() - t0
+        # timed_chunks = the axon-hardened device_get stop-clock
+        dt, state, _ = timed_chunks(run, state, max(1, n_timed // chunk))
         n_steps = max(1, n_timed // chunk) * chunk
         rate = n_steps / dt / n_chips
         mfu_block = _mfu_fields(run, state, dt / n_steps)
@@ -204,8 +219,9 @@ def bench_config(name: str, n_timed: int) -> int:
         "extra": {
             "chips": n_chips,
             "mesh": mesh_note,
-            "global_batch": cfg.batch_size,
-            "examples_per_sec": round(rate * n_chips * cfg.batch_size),
+            "global_batch": global_batch,
+            "batch_note": batch_note,
+            "examples_per_sec": round(rate * n_chips * global_batch),
             **mfu_block,
             **_anchor_fields(f"{name}_steps_per_sec_per_chip", rate),
         },
@@ -223,6 +239,7 @@ def main() -> int:
     from dist_mnist_tpu.parallel.sharding import shard_train_state
     from dist_mnist_tpu.train import create_train_state, evaluate, make_eval_step
     from dist_mnist_tpu.train.step import make_scanned_train_fn
+    from dist_mnist_tpu.utils.timing import timed_chunks
 
     n_chips = jax.device_count()
     mesh = make_mesh(MeshSpec(data=-1))
@@ -255,15 +272,10 @@ def main() -> int:
                 wall_to_99 = time.monotonic() - t_start
                 break
 
-        # --- steady-state throughput (post-compile, post-warmup) ---
-        state, out = run(state)
-        jax.block_until_ready(out["loss"])
+        # --- steady-state throughput (post-compile, post-warmup; the
+        # axon-hardened device_get stop-clock, utils/timing.py) ---
         n_timed = 2000
-        t0 = time.monotonic()
-        for _ in range(n_timed // chunk):
-            state, out = run(state)
-        jax.block_until_ready(out["loss"])
-        dt = time.monotonic() - t0
+        dt, state, _ = timed_chunks(run, state, n_timed // chunk)
         mfu_block = _mfu_fields(run, state, dt / n_timed)
 
     steps_per_sec_per_chip = n_timed / dt / n_chips

@@ -147,8 +147,7 @@ def run_config(
     from dist_mnist_tpu.obs import make_default_writer
     from dist_mnist_tpu.ops import losses
     from dist_mnist_tpu.parallel.sharding import (
-        DP_RULES,
-        TP_RULES,
+        resolve_rules,
         shard_train_state,
     )
     from dist_mnist_tpu.train import (
@@ -168,11 +167,7 @@ def run_config(
             "(--input_pipeline=device|device_sharded): a host batcher "
             "cannot feed a compiled multi-step scan"
         )
-    if cfg.sharding_rules not in ("dp", "tp"):
-        raise ValueError(
-            f"unknown sharding_rules {cfg.sharding_rules!r}; use 'dp' | 'tp'"
-        )
-    rules = {"dp": DP_RULES, "tp": TP_RULES}[cfg.sharding_rules]
+    rules = resolve_rules(cfg.sharding_rules)
     if scan_chunk and cfg.train_steps % scan_chunk:
         stop_at = -(-cfg.train_steps // scan_chunk) * scan_chunk
         log.warning(

@@ -26,6 +26,10 @@ class Config:
     optimizer: str = "adam"  # adam | sgd | momentum
     model_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
     mesh: MeshSpec = MeshSpec()  # data = all devices by default
+    ladder_devices: int = 1  # chip count the BASELINE ladder sizes this
+    # config's GLOBAL batch for; on a smaller box, bench preserves the
+    # per-chip batch (batch_size/ladder_devices per chip) instead of
+    # cramming the whole pod-slice batch into one chip's HBM
     loss: str = "stable"  # "clipped" = reference parity loss
     lr_schedule: str = "constant"  # constant | cosine
     warmup_steps: int = 0
@@ -74,6 +78,7 @@ CONFIGS = {
         train_steps=3000,
         learning_rate=1e-3,
         mesh=MeshSpec(data=4),
+        ladder_devices=4,
     ),
     # 4) ResNet-20 / CIFAR-10 / 8-way DP
     "resnet20_cifar": Config(
@@ -88,6 +93,7 @@ CONFIGS = {
         grad_clip_norm=1.0,
         augment=True,  # pad-crop-flip: standard CIFAR recipe, on device
         mesh=MeshSpec(data=8),
+        ladder_devices=8,
     ),
     # 5) ViT-Tiny / CIFAR-10 / pod slice (stretch; attention path)
     "vit_tiny_cifar": Config(
@@ -105,6 +111,7 @@ CONFIGS = {
         augment=True,
         model_kwargs={"scan_blocks": True},  # one compiled block, not 12
         mesh=MeshSpec(data=-1),  # whole slice
+        ladder_devices=16,  # "v4-32" = 32 TensorCores = 16 chips
     ),
     # 5b) config 5 with Ulysses sequence parallelism (SURVEY.md §5.7): the
     # all-to-all SP alternative to ring attention, selectable like any
@@ -126,6 +133,7 @@ CONFIGS = {
         model_kwargs={"attention_impl": "ulysses", "pool": "mean",
                       "heads": 4, "scan_blocks": True},
         mesh=MeshSpec(data=-1, seq=2),
+        ladder_devices=16,
     ),
     # 5c) config 5 with switch-MoE FFN blocks, expert-parallel over a
     # 4-way `model` axis (one expert per rank — parallel/moe.py); the
@@ -146,6 +154,7 @@ CONFIGS = {
         model_kwargs={"mlp_impl": "moe", "n_experts": 4, "pool": "mean",
                       "scan_blocks": True},
         mesh=MeshSpec(data=-1, model=4),
+        ladder_devices=16,
     ),
     # 5e) config 5 tensor-parallel: qkv/mlp matmuls Megatron-sharded over a
     # 2-way `model` axis (TP_RULES column/row pattern); grads for the
@@ -166,6 +175,7 @@ CONFIGS = {
         model_kwargs={"scan_blocks": True},
         sharding_rules="tp",
         mesh=MeshSpec(data=-1, model=2),
+        ladder_devices=16,
     ),
     # 5f) config 5 with ring attention over a 2-way `seq` axis (blockwise
     # K/V rotation around the ICI ring — parallel/ring_attention.py).
@@ -185,6 +195,7 @@ CONFIGS = {
         model_kwargs={"attention_impl": "ring", "pool": "mean",
                       "scan_blocks": True},
         mesh=MeshSpec(data=-1, seq=2),
+        ladder_devices=16,
     ),
     # 5d) config 5 with the block stack GPipe'd over a 4-stage `pipe` axis
     # (3 blocks per stage, microbatched activations around the ICI ring —
@@ -205,6 +216,7 @@ CONFIGS = {
         model_kwargs={"scan_blocks": True, "block_pipeline": 4,
                       "dropout_rate": 0.0},
         mesh=MeshSpec(data=-1, pipe=4),
+        ladder_devices=16,
     ),
 }
 

@@ -52,6 +52,17 @@ TP_RULES = ShardingRules(
 )
 
 
+def resolve_rules(name: str) -> ShardingRules:
+    """Config-string -> rules (`Config.sharding_rules`). One definition so
+    every driver (cli/train.py, bench.py) benchmarks/trains the SAME
+    strategy a config names — a driver that forgot to thread this through
+    would silently run DP under a TP config's name."""
+    table = {"dp": DP_RULES, "tp": TP_RULES}
+    if name not in table:
+        raise ValueError(f"unknown sharding_rules {name!r}; use 'dp' | 'tp'")
+    return table[name]
+
+
 def _paths(tree):
     flat, treedef = jax.tree.flatten_with_path(tree)
     paths = ["/".join(str(getattr(k, "key", k)) for k in path) for path, _ in flat]

@@ -1,0 +1,34 @@
+"""The hardened throughput stop-clock (single definition, used by bench.py,
+scripts/perf_sweep.py and scripts/step_ablation.py).
+
+On this image's experimental axon TPU relay, `jax.block_until_ready` can
+return EARLY once several compiled programs have executed in one process —
+measured symptom: benchmark rates above the chip's physical peak (up to
+3.5M steps/sec ≈ 44 PFLOP/s on a 197 TFLOP/s v5e), unstable run-to-run.
+A `jax.device_get` of the final output is immune: actual bytes cannot be
+handed back before the dependency chain has executed. docs/PERF.md
+("Timing methodology") records the evidence; keep every timed loop on this
+helper so a future clock fix lands in one place.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def timed_chunks(run_fn, state, n_chunks: int):
+    """Warm up once, then time `n_chunks` chained `state -> (state, out)`
+    calls; the clock stops on a device_get of the final `out["loss"]`.
+
+    Returns `(seconds, final_state, final_loss)` — callers should surface
+    the loss as an executed-for-real sanity check (it decreases under
+    training; a chain that never ran would not)."""
+    state, out = run_fn(state)  # compile + warmup, outside the clock
+    float(jax.device_get(out["loss"]))
+    t0 = time.monotonic()
+    for _ in range(n_chunks):
+        state, out = run_fn(state)
+    loss = float(jax.device_get(out["loss"]))
+    return time.monotonic() - t0, state, loss

@@ -16,19 +16,21 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+# runnable as `python scripts/perf_sweep.py` from anywhere: the repo root
+# must join sys.path WITHOUT touching PYTHONPATH (which would shadow the
+# .axon_site entry that registers the TPU platform plugin in this image)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
 
-def time_variant(run_fn, state, n_chunks: int):
-    state, out = run_fn(state)  # compile + warmup
-    jax.block_until_ready(out["loss"])
-    t0 = time.monotonic()
-    for _ in range(n_chunks):
-        state, out = run_fn(state)
-    jax.block_until_ready(out["loss"])
-    return time.monotonic() - t0, state
+# the axon-hardened device_get stop-clock (single definition; the loss it
+# returns is printed per variant as an executed-for-real sanity check)
+from dist_mnist_tpu.utils.timing import timed_chunks as time_variant  # noqa: E402
 
 
 def main():
@@ -80,12 +82,14 @@ def main():
                         remat=remat,
                     )
                     n_chunks = max(1, args.steps // chunk)
-                    dt, _ = time_variant(run, fresh_state(model), n_chunks)
+                    dt, _, loss = time_variant(run, fresh_state(model),
+                                               n_chunks)
                     steps = n_chunks * chunk
                     results.append({
                         "variant": f"scan{chunk}_{dtype_name}"
                                    + ("_remat" if remat else ""),
                         "steps_per_sec_per_chip": round(steps / dt / n_chips, 2),
+                        "final_loss": round(loss, 4),
                     })
                     print(json.dumps(results[-1]), flush=True)
 
@@ -95,16 +99,17 @@ def main():
         state = fresh_state(model)
         batches = iter(ShardedBatcher(dataset, args.batch, mesh, seed=0))
         state, out = step(state, next(batches))
-        jax.block_until_ready(out["loss"])
+        float(jax.device_get(out["loss"]))
         n = min(args.steps, 500)
         t0 = time.monotonic()
         for _ in range(n):
             state, out = step(state, next(batches))
-        jax.block_until_ready(out["loss"])
+        loss = float(jax.device_get(out["loss"]))  # the stop-clock fetch
         dt = time.monotonic() - t0
         results.append({
             "variant": "host_feed_per_step",
             "steps_per_sec_per_chip": round(n / dt / n_chips, 2),
+            "final_loss": round(loss, 4),
         })
         print(json.dumps(results[-1]), flush=True)
 

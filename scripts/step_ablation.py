@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Step-time attribution for the headline LeNet-5 config (docs/PERF.md).
+
+Times progressively larger slices of the scanned training step, all as
+chunk-of-100 `lax.scan` programs with the device_get stop-clock
+(dist_mnist_tpu/utils/timing.py — block_until_ready is not trusted on this
+image's axon relay):
+
+  fwd               forward + loss only, fixed resident batch
+  fwd_bwd           + value_and_grad (train mode: dropout included)
+  fwd_bwd_adam      + optimizer update + param apply (fixed batch)
+  full              the real fused step (adds the in-program batch gather,
+                    metrics, and per-step rng/step bookkeeping)
+  full_nodropout    full with dropout_rate=0 (isolates the dropout mask)
+
+Deltas between rows attribute per-step time to backward, optimizer,
+sampling+metrics (full − fwd_bwd_adam: both run dropout, so the delta is
+the gather/metrics/bookkeeping cost), and dropout (full − full_nodropout).
+JSON line per row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timed_scan(body, carry, chunk: int, n_chunks: int):
+    """carry -> carry scans, compiled once; returns per-step seconds.
+    Same device_get stop-clock discipline as utils/timing.timed_chunks
+    (these bodies have no out["loss"], so the fetch is the carry leaf)."""
+
+    @jax.jit
+    def run(c):
+        return jax.lax.scan(lambda cc, _: (body(cc), None), c, None,
+                            length=chunk)[0]
+
+    carry = run(carry)
+    jax.device_get(jax.tree.leaves(carry)[0])  # warmup + real sync
+    t0 = time.monotonic()
+    for _ in range(n_chunks):
+        carry = run(carry)
+    jax.device_get(jax.tree.leaves(carry)[0])
+    return (time.monotonic() - t0) / (chunk * n_chunks)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=200)
+    ap.add_argument("--chunk", type=int, default=100)
+    ap.add_argument("--chunks", type=int, default=20)
+    args = ap.parse_args()
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
+    from dist_mnist_tpu.data import DeviceDataset, load_dataset
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.ops import losses
+    from dist_mnist_tpu.optim.base import apply_updates
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state
+    from dist_mnist_tpu.train.step import make_scanned_train_fn
+    from dist_mnist_tpu.utils.timing import timed_chunks
+
+    mesh = make_mesh(MeshSpec(data=-1))
+    ds = load_dataset("mnist", "/tmp/mnist-data", seed=0)
+    model = get_model("lenet5")
+    optimizer = optim.adam(1e-3)
+
+    with activate(mesh):
+        state = shard_train_state(
+            create_train_state(model, optimizer, jax.random.PRNGKey(0),
+                               ds.train_images[:1]),
+            mesh,
+        )
+        dd = DeviceDataset(ds, mesh)
+        fixed = dd.sample(jax.random.PRNGKey(1), args.batch)
+        x_fixed = fixed["image"].astype(jnp.float32) / 255.0
+        y_fixed = fixed["label"]
+        results = {}
+
+        def emit(name, secs):
+            results[name] = secs
+            print(json.dumps({"variant": name, "us_per_step":
+                              round(secs * 1e6, 1)}), flush=True)
+
+        def time_full(name, a_model, a_state):
+            """The real fused step via the shared stop-clock helper."""
+            run = make_scanned_train_fn(a_model, optimizer, mesh, dd,
+                                        args.batch, args.chunk)
+            dt, _, _ = timed_chunks(run, a_state, args.chunks)
+            emit(name, dt / (args.chunk * args.chunks))
+
+        key = jax.random.PRNGKey(2)
+
+        # --- fwd: forward + loss on a fixed batch; carry = a scalar so the
+        # scan has a data dependency chain without touching params.
+        # train=True with the SAME fixed rng as the grad slices, so the
+        # fwd/fwd_bwd delta isolates ONLY the backward pass (dropout's
+        # forward cost would otherwise be double-counted into "backward")
+        def fwd_body(acc):
+            logits, _ = model.apply(state.params, state.model_state, x_fixed,
+                                    train=True, rng=key)
+            return acc + losses.softmax_cross_entropy(logits, y_fixed)
+
+        emit("fwd", timed_scan(fwd_body, jnp.zeros(()), args.chunk,
+                               args.chunks))
+
+        # --- fwd_bwd: + grad; carry = params so bwd output feeds the chain
+        def loss_of(params, key):
+            logits, _ = model.apply(params, state.model_state, x_fixed,
+                                    train=True, rng=key)
+            return losses.softmax_cross_entropy(logits, y_fixed)
+
+        def fwd_bwd_body(params):
+            g = jax.grad(loss_of)(params, key)
+            # fold the grads back in (scaled to ~0) to keep the chain honest
+            return jax.tree.map(lambda p, gg: p - 0.0 * gg, params, g)
+
+        emit("fwd_bwd", timed_scan(fwd_bwd_body, state.params, args.chunk,
+                                   args.chunks))
+
+        # --- fwd_bwd_adam: + the real optimizer pipeline on a fixed batch
+        def adam_body(carry):
+            params, opt_state = carry
+            g = jax.grad(loss_of)(params, key)
+            updates, opt_state = optimizer.update(g, opt_state, params)
+            return apply_updates(params, updates), opt_state
+
+        emit("fwd_bwd_adam",
+             timed_scan(adam_body, (state.params, state.opt_state),
+                        args.chunk, args.chunks))
+
+        # --- the real fused step, with and without the dropout mask
+        time_full("full", model, state)
+        model_nd = get_model("lenet5", dropout_rate=0.0)
+        state_nd = shard_train_state(
+            create_train_state(model_nd, optimizer, jax.random.PRNGKey(0),
+                               ds.train_images[:1]),
+            mesh,
+        )
+        time_full("full_nodropout", model_nd, state_nd)
+
+    d = {k: v * 1e6 for k, v in results.items()}
+    print(json.dumps({"attribution_us": {
+        "forward": round(d["fwd"], 1),
+        "backward": round(d["fwd_bwd"] - d["fwd"], 1),
+        "optimizer": round(d["fwd_bwd_adam"] - d["fwd_bwd"], 1),
+        "sampling+metrics": round(d["full"] - d["fwd_bwd_adam"], 1),
+        "dropout_only": round(d["full"] - d["full_nodropout"], 1),
+        "full_step": round(d["full"], 1),
+    }}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""bench.py ladder-mode batch scaling (pure logic, no backend).
+
+A ladder config's global batch is sized for its `ladder_devices` chip count
+(BASELINE.md configs 3-5); bench preserves the per-chip batch on smaller
+boxes so (a) steps/sec/chip stays comparable to the intended topology and
+(b) a pod-slice batch cannot OOM a single chip (the measured failure that
+motivated this: vit_tiny_cifar's batch-1024 step needs 19.4G HBM vs the
+v5e's 15.75G).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench
+from dist_mnist_tpu.configs import get_config
+
+
+def test_full_ladder_runs_config_batch():
+    cfg = get_config("resnet20_cifar")  # ladder_devices=8, batch 1024
+    batch, note = bench.ladder_batch(cfg, 8)
+    assert batch == 1024
+    assert note == "config global batch"
+    # more chips than the ladder needs: still the config batch
+    assert bench.ladder_batch(cfg, 16)[0] == 1024
+
+
+def test_small_box_preserves_per_chip_batch():
+    cfg = get_config("vit_tiny_cifar")  # ladder_devices=16, batch 1024
+    batch, note = bench.ladder_batch(cfg, 1)
+    assert batch == 1024 // 16  # 64/chip
+    assert "per-chip geometry" in note and "16-chip" in note
+    # 4 of 16 chips -> 4x the per-chip batch
+    assert bench.ladder_batch(cfg, 4)[0] == 4 * 64
+
+
+def test_single_chip_configs_never_scale():
+    for name in ("mlp_mnist", "lenet5_mnist"):  # ladder_devices=1
+        cfg = get_config(name)
+        assert bench.ladder_batch(cfg, 1)[0] == cfg.batch_size
+
+
+def test_every_ladder_config_declares_a_consistent_ladder():
+    from dist_mnist_tpu.configs import CONFIGS
+
+    for cfg in CONFIGS.values():
+        assert cfg.ladder_devices >= 1
+        # per-chip batch must stay integral on the declared ladder
+        assert cfg.batch_size % cfg.ladder_devices == 0, cfg.name
