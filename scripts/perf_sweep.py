@@ -18,7 +18,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 # runnable as `python scripts/perf_sweep.py` from anywhere: the repo root
 # must join sys.path WITHOUT touching PYTHONPATH (which would shadow the
@@ -98,14 +97,12 @@ def main():
         step = make_train_step(model, optimizer, mesh)
         state = fresh_state(model)
         batches = iter(ShardedBatcher(dataset, args.batch, mesh, seed=0))
-        state, out = step(state, next(batches))
-        float(jax.device_get(out["loss"]))
         n = min(args.steps, 500)
-        t0 = time.monotonic()
-        for _ in range(n):
-            state, out = step(state, next(batches))
-        loss = float(jax.device_get(out["loss"]))  # the stop-clock fetch
-        dt = time.monotonic() - t0
+        # same shared stop-clock as every other number (timed_chunks);
+        # the warmup call consumes one batch, as before
+        dt, state, loss = time_variant(
+            lambda s: step(s, next(batches)), state, n
+        )
         results.append({
             "variant": "host_feed_per_step",
             "steps_per_sec_per_chip": round(n / dt / n_chips, 2),

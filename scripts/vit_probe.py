@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""ViT-Ti step-time variant probe (docs/PERF.md ViT ladder row).
+
+The single-chip vit_tiny_cifar ladder point (64/chip, depth-12,
+remat+augment+dropout, scan_blocks) measured 74.5 steps/s = 0.5 % MFU —
+far below what dim-192 matmuls should sustain even at batch 64. This
+script times the same step with one knob flipped at a time to attribute
+the gap: remat off, augment off, dropout off, unrolled blocks, and a
+2x/4x batch (is it the small-batch regime or a fixed overhead?).
+
+JSON line per variant (device_get stop-clock, utils/timing.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--chunk", type=int, default=50)
+    ap.add_argument("--chunks", type=int, default=4)
+    args = ap.parse_args()
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dist_mnist_tpu.cli.train import build_optimizer
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.data import DeviceDataset, load_dataset
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state
+    from dist_mnist_tpu.train.step import make_scanned_train_fn
+    from dist_mnist_tpu.utils.flops import mfu, step_flops
+    from dist_mnist_tpu.utils.timing import timed_chunks
+
+    cfg = get_config("vit_tiny_cifar")
+    mesh = make_mesh(MeshSpec(data=-1))
+    dataset = load_dataset(cfg.dataset, "/tmp/mnist-data", seed=cfg.seed)
+    optimizer = build_optimizer(cfg)
+
+    variants = [
+        ("ladder_point", {}, dict(remat=cfg.remat, augment=cfg.augment),
+         args.batch),
+        ("no_remat", {}, dict(remat=False, augment=cfg.augment), args.batch),
+        ("no_augment", {}, dict(remat=cfg.remat, augment=False), args.batch),
+        ("no_dropout", {"dropout_rate": 0.0},
+         dict(remat=cfg.remat, augment=cfg.augment), args.batch),
+        ("lean", {"dropout_rate": 0.0}, dict(remat=False, augment=False),
+         args.batch),
+        ("unrolled", {"scan_blocks": False},
+         dict(remat=cfg.remat, augment=cfg.augment), args.batch),
+        ("batch_2x", {}, dict(remat=cfg.remat, augment=cfg.augment),
+         2 * args.batch),
+        ("batch_4x", {}, dict(remat=cfg.remat, augment=cfg.augment),
+         4 * args.batch),
+    ]
+
+    with activate(mesh):
+        dd = DeviceDataset(dataset, mesh)
+        for name, mkw, skw, batch in variants:
+            model = get_model(cfg.model, **{**cfg.model_kwargs, **mkw})
+            state = shard_train_state(
+                create_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   dataset.train_images[:1]),
+                mesh,
+            )
+            run = make_scanned_train_fn(model, optimizer, mesh, dd, batch,
+                                        args.chunk, **skw)
+            dt, state, loss = timed_chunks(run, state, args.chunks)
+            per_step = dt / (args.chunk * args.chunks)
+            fl = step_flops(run, state)
+            print(json.dumps({
+                "variant": name, "batch": batch,
+                "steps_per_sec": round(1.0 / per_step, 1),
+                "examples_per_sec": round(batch / per_step),
+                "mfu": round(mfu(fl, per_step) or 0.0, 4),
+                "flops_per_step": round(fl) if fl else None,
+                "final_loss": round(loss, 4),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
