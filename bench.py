@@ -26,6 +26,14 @@ line — `BENCH_r*.json.parsed` can never be null again (VERDICT r2 item 1).
 Ladder mode (`python bench.py --config resnet20_cifar [--steps N]`) times
 any BASELINE.md config's steady-state steps/sec/chip + MFU on the config's
 own mesh when this box has enough chips (single-chip fallback is labeled).
+
+CPU smoke mode: an explicit `JAX_PLATFORMS=cpu` (+
+`XLA_FLAGS=--xla_force_host_platform_device_count=N`) is honored in both
+the probe and the run — a no-TPU CI lane for the bench plumbing itself.
+MFU/anchors are correctly absent (unknown CPU peak, device_kind mismatch).
+Use LIGHT configs only (mlp_mnist): XLA-CPU compiles of the conv configs'
+scanned chunks exceed any reasonable deadline, and the SIGALRM watchdog
+will (by design) convert that into a structured error line.
 """
 
 from __future__ import annotations
@@ -57,6 +65,32 @@ def emit_error(metric: str, message: str, **extra) -> None:
     })
 
 
+# The axon sitecustomize in this image force-selects the TPU platform; an
+# explicit JAX_PLATFORMS=cpu must be re-applied in-process to take effect
+# (cluster.coordination.force_platform — the same mechanism behind
+# cli/train's --platform). Lets bench's ladder paths run on a CPU mesh
+# (CI smoke) and keeps the probe honest about WHICH backend the run uses.
+# The subprocess string is the probe-side half of the same logic.
+_PLATFORM_OVERRIDE = (
+    "import os, sys\n"
+    f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+    "if os.environ.get('JAX_PLATFORMS', '').strip() == 'cpu':\n"
+    "    from dist_mnist_tpu.cluster.coordination import force_platform\n"
+    "    force_platform('cpu')\n"
+    "import jax\n"
+)
+
+
+def apply_platform_override() -> None:
+    """In-process half of the override above. Call AFTER probe_backend():
+    it imports jax, and an import failure here would crash without the
+    structured JSON line the probe guarantees."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        from dist_mnist_tpu.cluster.coordination import force_platform
+
+        force_platform("cpu")
+
+
 def probe_backend(metric: str, retries: int = 3, timeout_s: int = 150) -> bool:
     """Bounded out-of-process backend probe. A hung/down TPU tunnel makes
     `import jax; jax.devices()` block or die IN-PROCESS — exactly what
@@ -67,7 +101,8 @@ def probe_backend(metric: str, retries: int = 3, timeout_s: int = 150) -> bool:
         try:
             out = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print('DEVCOUNT', jax.device_count())"],
+                 _PLATFORM_OVERRIDE
+                 + "print('DEVCOUNT', jax.device_count())"],
                 capture_output=True, text=True, timeout=timeout_s,
             )
             if out.returncode == 0 and "DEVCOUNT" in out.stdout:
@@ -331,6 +366,7 @@ if __name__ == "__main__":
     install_deadline(metric, args.deadline)
     if not probe_backend(metric):
         sys.exit(0)  # structured error line already printed
+    apply_platform_override()  # after the probe: see its docstring
 
     # persistent XLA compile cache for BOTH modes: repeat invocations skip
     # the ~45 s of scan/init/eval compiles entirely (cold-compile time still

@@ -46,29 +46,35 @@ def main():
 
     cfg = get_config("vit_tiny_cifar")
     mesh = make_mesh(MeshSpec(data=-1))
+    n_chips = mesh.devices.size
     dataset = load_dataset(cfg.dataset, "/tmp/mnist-data", seed=cfg.seed)
     optimizer = build_optimizer(cfg)
+    # --batch is PER CHIP (the ladder point's 64/chip), like bench's
+    # ladder_batch: scale to the mesh so a multi-chip run times the same
+    # per-chip regime and steps/sec divides into steps/sec/chip honestly
+    per_chip = args.batch
 
     variants = [
         ("ladder_point", {}, dict(remat=cfg.remat, augment=cfg.augment),
-         args.batch),
-        ("no_remat", {}, dict(remat=False, augment=cfg.augment), args.batch),
-        ("no_augment", {}, dict(remat=cfg.remat, augment=False), args.batch),
+         per_chip),
+        ("no_remat", {}, dict(remat=False, augment=cfg.augment), per_chip),
+        ("no_augment", {}, dict(remat=cfg.remat, augment=False), per_chip),
         ("no_dropout", {"dropout_rate": 0.0},
-         dict(remat=cfg.remat, augment=cfg.augment), args.batch),
+         dict(remat=cfg.remat, augment=cfg.augment), per_chip),
         ("lean", {"dropout_rate": 0.0}, dict(remat=False, augment=False),
-         args.batch),
+         per_chip),
         ("unrolled", {"scan_blocks": False},
-         dict(remat=cfg.remat, augment=cfg.augment), args.batch),
+         dict(remat=cfg.remat, augment=cfg.augment), per_chip),
         ("batch_2x", {}, dict(remat=cfg.remat, augment=cfg.augment),
-         2 * args.batch),
+         2 * per_chip),
         ("batch_4x", {}, dict(remat=cfg.remat, augment=cfg.augment),
-         4 * args.batch),
+         4 * per_chip),
     ]
 
     with activate(mesh):
         dd = DeviceDataset(dataset, mesh)
-        for name, mkw, skw, batch in variants:
+        for name, mkw, skw, batch_per_chip in variants:
+            batch = batch_per_chip * n_chips
             model = get_model(cfg.model, **{**cfg.model_kwargs, **mkw})
             state = shard_train_state(
                 create_train_state(model, optimizer, jax.random.PRNGKey(0),
@@ -80,11 +86,16 @@ def main():
             dt, state, loss = timed_chunks(run, state, args.chunks)
             per_step = dt / (args.chunk * args.chunks)
             fl = step_flops(run, state)
+            util = mfu(fl, per_step)
             print(json.dumps({
-                "variant": name, "batch": batch,
-                "steps_per_sec": round(1.0 / per_step, 1),
+                "variant": name, "batch_per_chip": batch_per_chip,
+                "chips": n_chips,
+                "steps_per_sec_per_chip": round(1.0 / per_step / n_chips, 2),
                 "examples_per_sec": round(batch / per_step),
-                "mfu": round(mfu(fl, per_step) or 0.0, 4),
+                # null (not 0.0) when the chip's peak is unknown — the
+                # repo-wide "report unknowable MFU as null, never guess"
+                # rule (utils/flops.py)
+                "mfu": round(util, 4) if util is not None else None,
                 "flops_per_step": round(fl) if fl else None,
                 "final_loss": round(loss, 4),
             }), flush=True)

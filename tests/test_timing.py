@@ -1,0 +1,41 @@
+"""utils/timing.timed_chunks — the shared benchmark stop-clock contract.
+
+Every on-chip number in docs/PERF.md flows through this helper (bench.py,
+scripts/perf_sweep.py, scripts/step_ablation.py, scripts/vit_probe.py), so
+its contract is load-bearing: exactly one un-timed warmup call, n timed
+calls chained through the state, and the returned loss fetched from the
+final call's output.
+"""
+
+import jax.numpy as jnp
+
+from dist_mnist_tpu.utils.timing import timed_chunks
+
+
+def test_timed_chunks_contract():
+    calls = []
+
+    def run_fn(state):
+        calls.append(state)
+        return state + 1, {"loss": jnp.float32(100.0 - state)}
+
+    dt, final_state, loss = timed_chunks(run_fn, 0, n_chunks=3)
+    # warmup (state 0) + 3 timed calls, chained through the state
+    assert calls == [0, 1, 2, 3]
+    assert final_state == 4
+    # loss comes from the FINAL call's output (state 3 -> 97)
+    assert loss == 97.0
+    assert dt >= 0.0
+
+
+def test_timed_chunks_zero_chunks_still_warms_up():
+    calls = []
+
+    def run_fn(state):
+        calls.append(state)
+        return state + 1, {"loss": jnp.float32(state)}
+
+    dt, final_state, loss = timed_chunks(run_fn, 5, n_chunks=0)
+    assert calls == [5]  # warmup only
+    assert final_state == 6
+    assert loss == 5.0  # the warmup output is what the clock fetched
