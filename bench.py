@@ -91,11 +91,12 @@ def apply_platform_override() -> None:
         force_platform("cpu")
 
 
-def probe_backend(metric: str, retries: int = 3, timeout_s: int = 150) -> bool:
-    """Bounded out-of-process backend probe. A hung/down TPU tunnel makes
-    `import jax; jax.devices()` block or die IN-PROCESS — exactly what
-    produced round 1's unparseable bench. Probing in a subprocess bounds
-    the blast radius; retries cover transient tunnel restarts."""
+def _probe(retries: int, timeout_s: int) -> list[str]:
+    """Bounded out-of-process backend probe; [] on success, else the error
+    per attempt. A hung/down TPU tunnel makes `import jax; jax.devices()`
+    block or die IN-PROCESS — exactly what produced round 1's unparseable
+    bench. Probing in a subprocess bounds the blast radius; retries cover
+    transient tunnel restarts."""
     errs = []
     for attempt in range(retries):
         try:
@@ -106,15 +107,38 @@ def probe_backend(metric: str, retries: int = 3, timeout_s: int = 150) -> bool:
                 capture_output=True, text=True, timeout=timeout_s,
             )
             if out.returncode == 0 and "DEVCOUNT" in out.stdout:
-                return True
+                return []
             errs.append(f"rc={out.returncode}: {out.stderr.strip()[-300:]}")
         except subprocess.TimeoutExpired:
             errs.append(f"probe timed out after {timeout_s}s")
         if attempt < retries - 1:
             time.sleep(min(30, 5 * 2 ** attempt))
+    return errs
+
+
+def probe_backend(metric: str, retries: int = 3, timeout_s: int = 150) -> bool:
+    """Bench-mode probe: emits the bench-schema error line on failure."""
+    errs = _probe(retries, timeout_s)
+    if not errs:
+        return True
     emit_error(metric, "backend probe failed after "
                f"{retries} attempts: {errs[-1]}", probe_errors=errs)
     return False
+
+
+def probe_or_exit(script: str, retries: int = 2, timeout_s: int = 150) -> None:
+    """Shared preamble for the perf scripts (perf_sweep / step_ablation /
+    vit_probe): probe the backend boundedly (a down TPU tunnel otherwise
+    hangs them forever at first device use), exit(1) with a script-schema
+    JSON line on failure — NOT bench's steps/sec-shaped error line — and
+    apply the in-process platform override on success so the backend the
+    probe validated is the one the run uses."""
+    errs = _probe(retries, timeout_s)
+    if errs:
+        emit({"script": script, "error": "backend probe failed after "
+              f"{retries} attempts: {errs[-1]}", "probe_errors": errs})
+        sys.exit(1)
+    apply_platform_override()
 
 
 def install_deadline(metric: str, seconds: int) -> None:

@@ -39,6 +39,12 @@ def main():
     ap.add_argument("--model", default="lenet5")
     args = ap.parse_args()
 
+    # probe + platform override preamble shared with bench (bench.py):
+    # bounds the down-tunnel hang and pins the backend the probe validated
+    from bench import probe_or_exit
+
+    probe_or_exit("perf_sweep")
+
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

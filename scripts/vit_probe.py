@@ -30,6 +30,12 @@ def main():
     ap.add_argument("--chunks", type=int, default=4)
     args = ap.parse_args()
 
+    # probe + platform override preamble shared with bench (bench.py):
+    # bounds the down-tunnel hang and pins the backend the probe validated
+    from bench import probe_or_exit
+
+    probe_or_exit("vit_probe")
+
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

@@ -48,3 +48,29 @@ def test_every_ladder_config_declares_a_consistent_ladder():
         assert cfg.ladder_devices >= 1
         # per-chip batch must stay integral on the declared ladder
         assert cfg.batch_size % cfg.ladder_devices == 0, cfg.name
+
+
+def test_probe_or_exit_failure_emits_script_schema(monkeypatch, capsys):
+    """Script-mode probe failures must NOT reuse bench's steps/sec-shaped
+    error line (a consumer would read a fake 0.0 measurement)."""
+    import json
+
+    import pytest
+
+    monkeypatch.setattr(bench, "_probe", lambda r, t: ["probe timed out"])
+    with pytest.raises(SystemExit) as exc:
+        bench.probe_or_exit("my_script")
+    assert exc.value.code == 1
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["script"] == "my_script"
+    assert "probe timed out" in out["error"]
+    assert "value" not in out and "unit" not in out  # not bench's schema
+
+
+def test_probe_or_exit_success_applies_platform_override(monkeypatch):
+    calls = []
+    monkeypatch.setattr(bench, "_probe", lambda r, t: [])
+    monkeypatch.setattr(bench, "apply_platform_override",
+                        lambda: calls.append("override"))
+    bench.probe_or_exit("my_script")
+    assert calls == ["override"]  # the probed backend is the one pinned
