@@ -34,6 +34,16 @@ class LeNet5:
         }
         return params, {}
 
+    def flops_per_example(self, sample_shape) -> float:
+        """Analytic FORWARD FLOPs per example (conv/matmul MACs x2); see
+        MLP.flops_per_example for why every model publishes this."""
+        h, w, c = (int(d) for d in sample_shape[1:])
+        conv1 = h * w * 32 * (5 * 5 * c) * 2
+        conv2 = (h // 2) * (w // 2) * 64 * (5 * 5 * 32) * 2
+        fc1 = ((h // 4) * (w // 4) * 64) * 512 * 2
+        fc2 = 512 * self.num_classes * 2
+        return float(conv1 + conv2 + fc1 + fc2)
+
     def apply(self, params, state, x, *, train=False, rng=None):
         x = x.astype(self.compute_dtype)
         x = nn.relu(nn.conv2d(params["conv1"], x))

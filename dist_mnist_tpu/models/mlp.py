@@ -34,6 +34,18 @@ class MLP:
         }
         return params, {}
 
+    def flops_per_example(self, sample_shape) -> float:
+        """Analytic FORWARD FLOPs per example (matmul MACs x2; elementwise
+        ignored) — the standard MFU numerator. XLA's cost analysis cannot
+        be trusted for models whose layers run under `lax.scan` (it counts
+        a scan body once — utils/flops.py), so every model also publishes
+        the analytic count."""
+        in_dim = 1
+        for d in sample_shape[1:]:
+            in_dim *= int(d)
+        return 2.0 * (in_dim * self.hidden_units
+                      + self.hidden_units * self.num_classes)
+
     def apply(self, params, state, x, *, train=False, rng=None):
         x = nn.flatten(x).astype(self.compute_dtype)
         h = nn.relu(nn.dense(params["hid"], x))

@@ -70,6 +70,25 @@ class ResNet20:
                                        init=nn.xavier_uniform)
         return params, state
 
+    def flops_per_example(self, sample_shape) -> float:
+        """Analytic FORWARD FLOPs per example (conv/matmul MACs x2; BN and
+        elementwise ignored); see MLP.flops_per_example for why."""
+        h, w, c = (int(d) for d in sample_shape[1:])
+        total = h * w * self.widths[0] * (3 * 3 * c) * 2  # stem
+        cin = self.widths[0]
+        for si, cout in enumerate(self.widths):
+            for bi in range(self.blocks_per_stage):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                if stride == 2:
+                    h, w = h // 2, w // 2
+                total += h * w * cout * (3 * 3 * cin) * 2   # conv1
+                total += h * w * cout * (3 * 3 * cout) * 2  # conv2
+                if stride == 2 or cin != cout:
+                    total += h * w * cout * cin * 2         # 1x1 projection
+                cin = cout
+        total += cin * self.num_classes * 2  # head after global avg pool
+        return float(total)
+
     def apply(self, params, state, x, *, train=False, rng=None):
         x = x.astype(self.compute_dtype)
         x = nn.conv2d(params["stem"], x)

@@ -82,6 +82,30 @@ class ViTTiny:
     # back to the plain scan — one model, any topology.
     pipeline_microbatches: int = 8  # GPipe M; bubble = (N-1)/(M+N-1)
 
+    def flops_per_example(self, sample_shape) -> float:
+        """Analytic FORWARD FLOPs per example (matmul MACs x2; LN/softmax/
+        elementwise ignored). ESSENTIAL here: with `scan_blocks=True` the
+        depth-layer stack runs under `lax.scan`, and XLA's cost analysis
+        counts a scan body ONCE — the compiled-program FLOPs figure
+        understates the transformer stack by ~depth x (measured: 13.8G
+        reported vs ~46G actual fwd for the vit_tiny_cifar ladder point).
+        MFU must therefore use this analytic count (utils/flops.py)."""
+        h, w, c = (int(d) for d in sample_shape[1:])
+        s = (h // self.patch) * (w // self.patch)
+        if self.pool == "cls":
+            s += 1
+        d = self.dim
+        patch_embed = (s - (1 if self.pool == "cls" else 0)) * d \
+            * (self.patch * self.patch * c) * 2
+        per_block = (
+            s * 3 * d * d * 2          # qkv projection
+            + 2 * s * s * d * 2        # scores (QK^T) + apply (A*V)
+            + s * d * d * 2            # output projection
+            + 2 * s * d * (d * self.mlp_ratio) * 2  # mlp in + out
+        )
+        head = d * self.num_classes * 2
+        return float(patch_embed + self.depth * per_block + head)
+
     def init(self, rng, sample_input):
         h, w, c = (int(d) for d in sample_input.shape[1:])
         n_tokens = (h // self.patch) * (w // self.patch)

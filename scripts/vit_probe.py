@@ -47,7 +47,7 @@ def main():
     from dist_mnist_tpu.parallel.sharding import shard_train_state
     from dist_mnist_tpu.train import create_train_state
     from dist_mnist_tpu.train.step import make_scanned_train_fn
-    from dist_mnist_tpu.utils.flops import mfu, step_flops
+    from dist_mnist_tpu.utils.flops import analytic_step_flops, mfu
     from dist_mnist_tpu.utils.timing import timed_chunks
 
     cfg = get_config("vit_tiny_cifar")
@@ -91,7 +91,11 @@ def main():
                                         args.chunk, **skw)
             dt, state, loss = timed_chunks(run, state, args.chunks)
             per_step = dt / (args.chunk * args.chunks)
-            fl = step_flops(run, state)
+            # analytic, not XLA-counted (the scan-over-layers stack is
+            # understated ~depth x by cost_analysis), on the PER-CHIP
+            # basis bench uses: batch/chip FLOPs vs one chip's peak
+            fl = analytic_step_flops(model, dataset.train_images[:1].shape,
+                                     batch_per_chip)
             util = mfu(fl, per_step)
             print(json.dumps({
                 "variant": name, "batch_per_chip": batch_per_chip,

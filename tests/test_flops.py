@@ -101,3 +101,63 @@ def test_peak_and_mfu():
         device_kind = "AbacusAccelerator"
 
     assert mfu(1e9, 0.1, Unknown()) is None  # unknown chip -> null, not a guess
+
+
+def test_analytic_flops_match_xla_count_for_unscanned_models():
+    """The models' published analytic forward FLOPs must agree with XLA's
+    compiled-program count (which is trustworthy when no layer-scan is
+    involved) to within accounting slop — anchors the analytic numbers
+    bench uses as the MFU numerator of record."""
+    from dist_mnist_tpu.models import get_model
+
+    for name, shape in (("mlp", (1, 28, 28, 1)), ("lenet5", (1, 28, 28, 1))):
+        model = get_model(name, compute_dtype=jnp.float32)
+        x = jnp.zeros(shape, jnp.float32)
+        params, state = model.init(jax.random.PRNGKey(0), x)
+        fwd = jax.jit(lambda p, xx: model.apply(p, state, xx, train=False)[0])
+        counted = step_flops(fwd, params, x)
+        analytic = model.flops_per_example(shape)
+        assert counted is not None
+        assert 0.5 < counted / analytic < 1.5, (name, counted, analytic)
+
+
+def test_vit_scan_blocks_undercounts_but_analytic_does_not():
+    """THE bug analytic FLOPs exist to fix: XLA's cost analysis counts the
+    ViT layer-scan body once, so the compiled count of a scan_blocks model
+    understates the stack by ~depth x, while the unrolled twin (identical
+    numerics) matches the analytic figure."""
+    from dist_mnist_tpu.models import get_model
+
+    kw = dict(depth=4, dim=32, heads=2, patch=8, dropout_rate=0.0,
+              compute_dtype=jnp.float32)
+    shape = (1, 32, 32, 3)
+    x = jnp.zeros(shape, jnp.float32)
+
+    def counted(model):
+        params, state = model.init(jax.random.PRNGKey(0), x)
+        fwd = jax.jit(lambda p, xx: model.apply(p, state, xx, train=False)[0])
+        return step_flops(fwd, params, x)
+
+    scanned = get_model("vit_tiny", scan_blocks=True, **kw)
+    unrolled = get_model("vit_tiny", scan_blocks=False, **kw)
+    analytic = scanned.flops_per_example(shape)
+    c_scan, c_unroll = counted(scanned), counted(unrolled)
+    assert c_scan is not None and c_unroll is not None
+    # unrolled agrees with analytic; scanned is short by ~depth x
+    assert 0.5 < c_unroll / analytic < 1.5, (c_unroll, analytic)
+    assert c_scan < 0.5 * analytic, (c_scan, analytic)
+
+
+def test_analytic_step_flops_convention():
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.utils.flops import analytic_step_flops
+
+    model = get_model("mlp", hidden_units=100)
+    shape = (1, 28, 28, 1)
+    per_ex = model.flops_per_example(shape)
+    assert per_ex == 2 * (784 * 100 + 100 * 10)
+    # step = batch x (fwd + 2x bwd)
+    assert analytic_step_flops(model, shape, 64) == 64 * 3 * per_ex
+    # models without a published count -> None (callers fall back to XLA)
+    class Bare: ...
+    assert analytic_step_flops(Bare(), shape, 64) is None
