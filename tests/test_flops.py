@@ -161,3 +161,24 @@ def test_analytic_step_flops_convention():
     # models without a published count -> None (callers fall back to XLA)
     class Bare: ...
     assert analytic_step_flops(Bare(), shape, 64) is None
+
+
+import pytest
+
+
+@pytest.mark.slow  # the CIFAR ResNet fwd compile costs ~10 s on XLA-CPU
+def test_resnet_analytic_flops_match_xla_count():
+    """ResNet-20 has the most error-prone analytic formula (strides,
+    downsample projections) and feeds the published resnet20_cifar MFU —
+    pin it to XLA's count like the other models."""
+    from dist_mnist_tpu.models import get_model
+
+    model = get_model("resnet20", compute_dtype=jnp.float32)
+    shape = (1, 32, 32, 3)
+    x = jnp.zeros(shape, jnp.float32)
+    params, state = model.init(jax.random.PRNGKey(0), x)
+    fwd = jax.jit(lambda p, xx: model.apply(p, state, xx, train=False)[0])
+    counted = step_flops(fwd, params, x)
+    analytic = model.flops_per_example(shape)
+    assert counted is not None
+    assert 0.5 < counted / analytic < 1.5, (counted, analytic)
