@@ -14,6 +14,8 @@ collective over ICI compiled into the step program.
 - `ulysses.py` — all-to-all head<->sequence reshard alternative.
 - `pipeline.py` — GPipe pipeline parallelism over the `pipe` axis.
 - `moe.py` — expert-parallel switch MoE (all_to_all dispatch).
+- `collective_matmul.py` — explicit overlapped AG->matmul / matmul->RS
+  rings (the scaling-book TP idiom; GSPMD's automatic fusion is default).
 - `ps_demo/` — native C++ demo of the reference's async-PS protocol.
 """
 

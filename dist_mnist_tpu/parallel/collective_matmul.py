@@ -1,0 +1,111 @@
+"""Collective matmul: ICI communication overlapped behind chunk matmuls.
+
+The scaling-book TP recipe: a Megatron layer needs `all_gather(x) @ W_col`
+before the column-parallel matmul and a reduce(-scatter) after the
+row-parallel one. Done naively, the collective and the matmul serialize —
+the MXU idles for a full ICI round-trip per layer. The classic fix is to
+decompose the collective into its ring steps (one `ring_shift` hop per
+step) and interleave: matmul the chunk that is already resident while the
+next hop is in flight, so the ICI time hides behind MXU time whenever
+`chunk_matmul_time >= hop_time`.
+
+XLA's GSPMD already performs this fusion in common cases (it is the
+DEFAULT path everywhere else in this framework — see parallel/sharding.py);
+these explicit shard_map variants exist for when manual control is wanted
+(custom schedules, odd shapes GSPMD won't overlap) and as the executable
+documentation of what the compiler does on the `model` axis. Reference
+counterpart: none — the PS design (SURVEY.md §3.3) serialized ALL
+communication by construction; overlap is a TPU-native capability.
+
+Both primitives use the single counter-clockwise ring from
+collectives.ring_shift; `axis` is any live mesh axis name.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_mnist_tpu.parallel.collectives import ring_shift
+
+
+def allgather_matmul(x, w, mesh: Mesh, axis: str = "model"):
+    """`all_gather(x, axis) @ w` with the gather's ring hops overlapped.
+
+    x: [M, D] sharded over `axis` on dim 0 (M = n * m rows globally).
+    w: [D, F] sharded over `axis` on dim 1 (each device holds [D, F/n]).
+    Returns [M, F] sharded over `axis` on dim 1 — every device computes
+    the FULL row range against its own weight columns, chunk by chunk,
+    rotating the x shards around the ring between chunk matmuls.
+    """
+    n = mesh.shape[axis]
+
+    def body(x_local, w_local):
+        m = x_local.shape[0]
+        i = jax.lax.axis_index(axis)
+        out = jnp.zeros((n * m, w_local.shape[1]), x_local.dtype)
+        buf = x_local
+        for k in range(n):
+            # buf currently holds shard (i + k) % n; matmul it into its
+            # row block while the NEXT rotation's hop overlaps (XLA
+            # schedules the independent ring_shift alongside the dot)
+            block = (i + k) % n
+            out = jax.lax.dynamic_update_slice(
+                out, buf @ w_local, (block * m, 0)
+            )
+            if k < n - 1:
+                buf = ring_shift(buf, axis, reverse=True)
+        return out
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )(x, w)
+
+
+def matmul_reducescatter(x, w, mesh: Mesh, axis: str = "model"):
+    """`reduce_scatter(x @ w, axis)` with the reduction ring overlapped.
+
+    x: [M, D] with D sharded over `axis` (each device holds [M, D/n]).
+    w: [D, F] with D sharded over `axis` (each device holds [D/n, F]).
+    The full local partial `x_local @ w_local` is NEVER materialized:
+    each ring step matmuls ONE row chunk of x_local against w_local and
+    adds it to the in-flight accumulator — the chunk dot is independent
+    of the hop it rides alongside, so the ICI time hides behind the MXU
+    (the same schedule allgather_matmul uses, reversed). Each device ends
+    with its [M/n, F] row block of the true product — the Megatron
+    row-parallel epilogue without a serialized all-reduce.
+    """
+    n = mesh.shape[axis]
+
+    def body(x_local, w_local):
+        M = x_local.shape[0]
+        assert M % n == 0, f"rows {M} not divisible by {axis}={n}"
+        m = M // n
+        i = jax.lax.axis_index(axis)
+
+        def chunk_dot(idx):
+            rows = jax.lax.dynamic_slice(
+                x_local, (idx * m, 0), (m, x_local.shape[1])
+            )
+            return rows @ w_local  # [m, F] partial sum over local D
+
+        # ring reduce-scatter: at step s the accumulator on device i holds
+        # the growing partial sum for row block (i + 1 + s) mod n; after
+        # n-1 hops each block lands on its home device fully reduced. The
+        # step-s chunk_dot has no dependence on the in-flight hop.
+        acc = chunk_dot((i + 1) % n)
+        for s in range(1, n):
+            acc = ring_shift(acc, axis, reverse=True)
+            acc = acc + chunk_dot((i + 1 + s) % n)
+        return acc
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )(x, w)

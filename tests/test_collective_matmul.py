@@ -1,0 +1,79 @@
+"""Collective matmul (parallel/collective_matmul.py): the overlapped
+all-gather->matmul and matmul->reduce-scatter rings must match the dense
+product exactly, shard correctly, and differentiate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+from dist_mnist_tpu.parallel.collective_matmul import (
+    allgather_matmul,
+    matmul_reducescatter,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8m():
+    return make_mesh(MeshSpec(data=1, model=8))
+
+
+def _rand(shape, seed):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=shape), jnp.float32
+    )
+
+
+def test_allgather_matmul_matches_dense(mesh8m):
+    x = jax.device_put(_rand((16, 12), 0),
+                       NamedSharding(mesh8m, P("model", None)))
+    w = jax.device_put(_rand((12, 24), 1),
+                       NamedSharding(mesh8m, P(None, "model")))
+    out = allgather_matmul(x, w, mesh8m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ np.asarray(w),
+                               rtol=1e-5, atol=1e-5)
+    assert out.sharding.spec == P(None, "model")
+
+
+def test_matmul_reducescatter_matches_dense(mesh8m):
+    x = jax.device_put(_rand((16, 32), 2),
+                       NamedSharding(mesh8m, P(None, "model")))
+    w = jax.device_put(_rand((32, 8), 3),
+                       NamedSharding(mesh8m, P("model", None)))
+    out = matmul_reducescatter(x, w, mesh8m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ np.asarray(w),
+                               rtol=1e-5, atol=1e-5)
+    assert out.sharding.spec == P("model", None)
+
+
+def test_collective_matmul_differentiates(mesh8m):
+    """Usable inside a training step: grads flow through the ppermute
+    rings and match the dense matmul's grads."""
+    x = _rand((8, 12), 4)
+    w = _rand((12, 16), 5)
+
+    def loss_ring(w_):
+        return jnp.sum(allgather_matmul(x, w_, mesh8m) ** 2)
+
+    def loss_dense(w_):
+        return jnp.sum((x @ w_) ** 2)
+
+    g_ring = jax.grad(loss_ring)(w)
+    g_dense = jax.grad(loss_dense)(w)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_allgather_matmul_under_jit_two_axes():
+    """Composes with a data axis present (the realistic hybrid mesh) and
+    under jit."""
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    x = jax.device_put(_rand((8, 12), 6),
+                       NamedSharding(mesh, P("model", None)))
+    w = jax.device_put(_rand((12, 8), 7),
+                       NamedSharding(mesh, P(None, "model")))
+    out = jax.jit(lambda a, b: allgather_matmul(a, b, mesh))(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ np.asarray(w),
+                               rtol=1e-5, atol=1e-5)

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dist_mnist_tpu.utils.flops import device_peak_flops, mfu, step_flops
 
@@ -161,9 +162,6 @@ def test_analytic_step_flops_convention():
     # models without a published count -> None (callers fall back to XLA)
     class Bare: ...
     assert analytic_step_flops(Bare(), shape, 64) is None
-
-
-import pytest
 
 
 @pytest.mark.slow  # the CIFAR ResNet fwd compile costs ~10 s on XLA-CPU
