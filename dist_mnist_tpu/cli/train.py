@@ -62,6 +62,9 @@ flags.DEFINE_integer("host_device_count", None,
 flags.DEFINE_integer("num_processes", 1, "total processes (multi-host)")
 flags.DEFINE_integer("process_id", 0, "this process's index")
 flags.DEFINE_boolean("profile", False, "trace a window of steps to logdir")
+flags.DEFINE_string("prng_impl", None,
+                    "PRNG impl override: threefry2x32 (default) | rbg "
+                    "(faster dropout masks on TPU; see configs.py)")
 flags.DEFINE_integer("eval_every", None, "eval cadence in steps; 0 disables "
                      "(None = config value)")
 flags.DEFINE_integer("log_every", None, "log/summary cadence in steps")
@@ -118,7 +121,18 @@ def build_optimizer(cfg):
     return opt
 
 
-def run_config(
+def run_config(cfg, **kwargs):
+    """Public driver entrypoint (tests/bench call this; main() parses
+    flags) — see `_run_config` for the full signature. This thin wrapper
+    scopes `cfg.prng_impl` around the whole run; why and the checkpoint
+    caveat live on utils/prng.prng_impl_scope."""
+    from dist_mnist_tpu.utils.prng import prng_impl_scope
+
+    with prng_impl_scope(cfg.prng_impl):
+        return _run_config(cfg, **kwargs)
+
+
+def _run_config(
     cfg,
     *,
     data_dir: str = "/tmp/mnist-data",
@@ -131,7 +145,8 @@ def run_config(
     input_pipeline: str = "python",
     scan_chunk: int = 0,
 ):
-    """Programmatic entrypoint (tests/bench call this; main() parses flags).
+    """Implementation behind `run_config` (the public wrapper adds the
+    PRNG-impl scope — call THAT, not this).
 
     Returns (final_state, final_eval_dict, context) where context carries
     the mesh/model/etc. for callers that keep going.
@@ -333,6 +348,8 @@ def _apply_flag_overrides(cfg):
 
         kv = dict(part.split("=") for part in FLAGS.mesh.split(","))
         over["mesh"] = MeshSpec(**{k: int(v) for k, v in kv.items()})
+    if FLAGS.prng_impl:
+        over["prng_impl"] = FLAGS.prng_impl
     return dataclasses.replace(cfg, **over) if over else cfg
 
 

@@ -38,6 +38,10 @@ class Config:
     # column/row TP_RULES over the `model` axis — parallel/sharding.py)
     grad_clip_norm: float | None = None
     weight_decay: float = 0.0
+    prng_impl: str = "threefry2x32"  # | "rbg": hardware-friendly PRNG —
+    # threefry's bit-mixing is a known TPU cost for per-layer dropout
+    # masks; rbg trades cross-backend bit-reproducibility for speed
+    # (determinism WITHIN a backend is preserved)
     remat: bool = False  # jax.checkpoint the forward (HBM <-> FLOPs trade)
     augment: bool = False  # on-device pad-crop-flip (data/augment.py)
     eval_every: int = 1000

@@ -1,0 +1,36 @@
+"""Scoped PRNG-implementation selection.
+
+`rbg` exists because threefry2x32's bit-mixing is a measurable TPU cost
+for per-layer dropout masks (configs.py `prng_impl`); the impl must be the
+process default BEFORE any key is made so init, dropout, and in-program
+sampling derive from one impl, and must be restored afterwards so
+co-resident runs (tests, sweeps, probe variants) keep theirs. One
+definition — cli/train.run_config and scripts/vit_probe both scope
+through here. A checkpoint written under one impl must be resumed under
+the same impl (key shapes differ across impls, so a mismatch fails loudly
+at restore rather than silently).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def prng_impl_scope(impl: str):
+    """Make `impl` the process-default PRNG inside the scope.
+
+    Compares against the CURRENT default (not the library default), so an
+    explicit threefry config is enforced even when the ambient default was
+    changed by env or a prior caller; restores the previous default on
+    every exit path."""
+    import jax
+
+    prev = jax.config.jax_default_prng_impl
+    if impl != prev:
+        jax.config.update("jax_default_prng_impl", impl)
+    try:
+        yield
+    finally:
+        if impl != prev:
+            jax.config.update("jax_default_prng_impl", prev)

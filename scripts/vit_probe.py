@@ -14,6 +14,7 @@ JSON line per variant (device_get stop-clock, utils/timing.py).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -49,6 +50,7 @@ def main():
     from dist_mnist_tpu.train.step import make_scanned_train_fn
     from dist_mnist_tpu.utils.flops import analytic_step_flops, mfu
     from dist_mnist_tpu.utils.timing import timed_chunks
+    from dist_mnist_tpu.utils.prng import prng_impl_scope
 
     cfg = get_config("vit_tiny_cifar")
     mesh = make_mesh(MeshSpec(data=-1))
@@ -75,21 +77,31 @@ def main():
          2 * per_chip),
         ("batch_4x", {}, dict(remat=cfg.remat, augment=cfg.augment),
          4 * per_chip),
+        # rbg PRNG for the per-layer dropout masks (threefry bit-mixing is
+        # a known TPU cost); scoped via the rbg flag below
+        ("rbg_prng", {}, dict(remat=cfg.remat, augment=cfg.augment),
+         per_chip),
     ]
 
     with activate(mesh):
         dd = DeviceDataset(dataset, mesh)
         for name, mkw, skw, batch_per_chip in variants:
             batch = batch_per_chip * n_chips
-            model = get_model(cfg.model, **{**cfg.model_kwargs, **mkw})
-            state = shard_train_state(
-                create_train_state(model, optimizer, jax.random.PRNGKey(0),
-                                   dataset.train_images[:1]),
-                mesh,
-            )
-            run = make_scanned_train_fn(model, optimizer, mesh, dd, batch,
-                                        args.chunk, **skw)
-            dt, state, loss = timed_chunks(run, state, args.chunks)
+            # the rbg variant scopes the impl around BUILD + RUN (keys are
+            # made at state creation) via the shared context manager
+            scope = (prng_impl_scope("rbg") if name == "rbg_prng"
+                     else contextlib.nullcontext())
+            with scope:
+                model = get_model(cfg.model, **{**cfg.model_kwargs, **mkw})
+                state = shard_train_state(
+                    create_train_state(model, optimizer,
+                                       jax.random.PRNGKey(0),
+                                       dataset.train_images[:1]),
+                    mesh,
+                )
+                run = make_scanned_train_fn(model, optimizer, mesh, dd,
+                                            batch, args.chunk, **skw)
+                dt, state, loss = timed_chunks(run, state, args.chunks)
             per_step = dt / (args.chunk * args.chunks)
             # analytic, not XLA-counted (the scan-over-layers stack is
             # understated ~depth x by cost_analysis), on the PER-CHIP

@@ -168,3 +168,18 @@ def test_strategy_ladder_configs_through_driver(tmp_path, name, mesh,
     axis = {"vit_tiny_cifar_ulysses": "seq", "vit_tiny_cifar_ring": "seq",
             "vit_tiny_cifar_moe": "model", "vit_tiny_cifar_pp": "pipe"}[name]
     assert ctx["mesh"].shape[axis] > 1
+
+
+def test_prng_impl_rbg_trains_and_restores_default(tmp_path):
+    """cfg.prng_impl="rbg" (the TPU-fast dropout PRNG) trains through the
+    driver, and the process-global default impl is restored afterwards so
+    co-resident runs keep threefry."""
+    import jax
+
+    prev = jax.config.jax_default_prng_impl
+    cfg = get_config("mlp_mnist", train_steps=10, batch_size=32,
+                     eval_every=0, prng_impl="rbg")
+    state, final, _ = run_config(cfg, data_dir=str(tmp_path / "data"))
+    assert state.step_int == 10
+    assert np.isfinite(final["loss"])
+    assert jax.config.jax_default_prng_impl == prev
