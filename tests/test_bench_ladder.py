@@ -74,3 +74,16 @@ def test_probe_or_exit_success_applies_platform_override(monkeypatch):
                         lambda: calls.append("override"))
     bench.probe_or_exit("my_script")
     assert calls == ["override"]  # the probed backend is the one pinned
+
+
+def test_probe_backend_failure_carries_committed_anchor(monkeypatch, capsys):
+    """An outage line must surface the last committed on-chip number as
+    labeled context — value stays 0.0 (an outage is not a measurement)."""
+    import json
+
+    monkeypatch.setattr(bench, "_probe", lambda r, t: ["probe timed out"])
+    assert bench.probe_backend(bench.HEADLINE_METRIC, retries=1) is False
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.0 and "error" in out
+    anchor = out["extra"]["last_committed_anchor"]
+    assert anchor["value"] > 0 and "NOT produced by this run" in anchor["note"]
