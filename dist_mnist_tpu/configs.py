@@ -201,6 +201,27 @@ CONFIGS = {
         mesh=MeshSpec(data=-1, seq=2),
         ladder_devices=16,
     ),
+    # 5g) config 5 with the Pallas flash-attention kernel (fused VMEM
+    # softmax-attention, fwd + custom-VJP bwd — ops/pallas/flash_attention):
+    # the single-chip kernel leg of SURVEY §5.7's blockwise-attention row
+    # (ring/ulysses cover the sharded legs).
+    "vit_tiny_cifar_flash": Config(
+        name="vit_tiny_cifar_flash",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"attention_impl": "flash", "scan_blocks": True},
+        mesh=MeshSpec(data=-1),
+        ladder_devices=16,
+    ),
     # 5d) config 5 with the block stack GPipe'd over a 4-stage `pipe` axis
     # (3 blocks per stage, microbatched activations around the ICI ring —
     # parallel/pipeline.py). Dropout-free: stage fns carry no rng.

@@ -12,8 +12,17 @@ D<=128, bf16: ~2 MB score tile + ~4 MB K/V — inside the ~16 MB/core VMEM.
 For longer S, shard the sequence first (parallel/ring_attention.py) and let
 each device run this kernel on its local block.
 
-`interpret=True` (auto on non-TPU backends) runs the same kernel under the
-Pallas interpreter so the CPU test mesh covers it.
+Training: `flash_attention` carries a `jax.custom_vjp`. The forward kernel
+additionally emits the per-row log-sum-exp (LSE); the backward recomputes
+the score tiles from (q, k, lse) — the flash recipe: never store P — in two
+kernels, one tiled over query blocks (dQ) and one over key blocks (dK, dV),
+with `delta = rowsum(dO * O)` precomputed in XLA. Zero-padding of the
+sequence axis makes the padded rows/columns self-cancelling everywhere
+except the key-padding mask inside the dQ kernel (where forward masked the
+logits to -1e30, backward must too, or softmax mass leaks into dQ).
+
+`interpret=True` (auto on non-TPU backends) runs the same kernels under the
+Pallas interpreter so the CPU test mesh covers forward AND backward.
 """
 
 from __future__ import annotations
@@ -26,7 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, s_real: int):
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                     s_real: int):
     q = q_ref[0].astype(jnp.float32)  # [block_q, D]
     k = k_ref[0]  # [S_pad, D]
     v = v_ref[0]
@@ -40,35 +50,106 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, s_real: int):
     logits = jnp.where(col < s_real, logits, -1e30)
     m = jnp.max(logits, axis=-1, keepdims=True)
     p = jnp.exp(logits - m)
-    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    l = jnp.sum(p, axis=-1, keepdims=True)
     o = jax.lax.dot_general(
-        p.astype(v.dtype), v,
+        (p / l).astype(v.dtype), v,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     o_ref[0] = o.astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                    *, scale: float, s_real: int):
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    k = k_ref[0].astype(jnp.float32)  # [S_pad, D]
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)  # [block_q, D]
+    lse = lse_ref[0]  # [block_q]
+    delta = delta_ref[0]  # [block_q]
+    logits = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, S_pad]
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(col < s_real, logits, -1e30)  # forward's mask, replayed
+    p = jnp.exp(logits - lse[:, None])  # normalized probs, recomputed
+    dp = jax.lax.dot_general(  # dO @ V^T : [block_q, S_pad]
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    dq = jax.lax.dot_general(  # dS @ K : [block_q, D]
+        ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _attn_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, *, scale: float):
+    """One key tile against the full query axis. Query padding is zero-filled
+    (q=0, dO=0, delta=0) so padded columns cancel in both products; padded
+    KEY rows land in dk/dv rows that the caller slices off."""
+    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0].astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32)  # [Q_pad, D]
+    do = do_ref[0].astype(jnp.float32)  # [Q_pad, D]
+    lse = lse_ref[0]  # [Q_pad]
+    delta = delta_ref[0]  # [Q_pad]
+    logits_t = jax.lax.dot_general(  # K_tile @ Q^T : [block_k, Q_pad]
+        k, q, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    p_t = jnp.exp(logits_t - lse[None, :])  # P^T, recomputed
+    dv = jax.lax.dot_general(  # P^T @ dO : [block_k, D]
+        p_t, do, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp_t = jax.lax.dot_general(  # V_tile @ dO^T : [block_k, Q_pad]
+        v, do, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds_t = p_t * (dp_t - delta[None, :])
+    dk = jax.lax.dot_general(  # dS^T @ Q : [block_k, D]
+        ds_t, q, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _to_bh(x, b, h, s, d, length):  # [B,S,H,D] -> [B*H, length, D], zero-pad
+    x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+    return jnp.pad(x, ((0, 0), (0, length - s), (0, 0)))
+
+
+def _from_bh(x, b, h, s, d):  # [B*H, length, D] -> [B,S,H,D]
+    return jnp.moveaxis(x[:, :s].reshape(b, h, s, d), 1, 2)
+
+
 @functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
-def _flash_attention(q, k, v, block_q: int, interpret: bool):
+def _flash_fwd_impl(q, k, v, block_q: int, interpret: bool):
     b, s, h, d = q.shape
     scale = d**-0.5
     s_pad = _round_up(s, 128)
     q_pad = _round_up(s, block_q)
 
-    def to_bh(x, length):  # [B,S,H,D] -> [B*H, length, D]
-        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
-        return jnp.pad(x, ((0, 0), (0, length - s), (0, 0)))
-
-    qb, kb, vb = to_bh(q, q_pad), to_bh(k, s_pad), to_bh(v, s_pad)
+    qb = _to_bh(q, b, h, s, d, q_pad)
+    kb = _to_bh(k, b, h, s, d, s_pad)
+    vb = _to_bh(v, b, h, s, d, s_pad)
     grid = (b * h, q_pad // block_q)
-    out = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale, s_real=s),
-        out_shape=jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+    out, lse = pl.pallas_call(
+        functools.partial(_attn_fwd_kernel, scale=scale, s_real=s),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, q_pad), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
@@ -78,19 +159,103 @@ def _flash_attention(q, k, v, block_q: int, interpret: bool):
             pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ),
         interpret=interpret,
     )(qb, kb, vb)
-    out = out[:, :s].reshape(b, h, s, d)
-    return jnp.moveaxis(out, 1, 2)  # [B,S,H,D]
+    return _from_bh(out, b, h, s, d), lse
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def _flash_bwd_impl(q, k, v, out, lse, do, block_q: int, interpret: bool):
+    b, s, h, d = q.shape
+    scale = d**-0.5
+    s_pad = _round_up(s, 128)
+    q_pad = _round_up(s, block_q)
+
+    qb = _to_bh(q, b, h, s, d, q_pad)
+    kb = _to_bh(k, b, h, s, d, s_pad)
+    vb = _to_bh(v, b, h, s, d, s_pad)
+    ob = _to_bh(out, b, h, s, d, q_pad)
+    dob = _to_bh(do, b, h, s, d, q_pad)
+    # delta_i = sum_d dO_id * O_id — one cheap fused elementwise pass in XLA;
+    # zero on padded rows because dO and O are zero-padded.
+    delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1)
+
+    vec_spec_q = pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+                              memory_space=pltpu.VMEM)
+    mat_full_s = pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
+                              memory_space=pltpu.VMEM)
+    mat_tile_q = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+                              memory_space=pltpu.VMEM)
+
+    dqb = pl.pallas_call(
+        functools.partial(_attn_dq_kernel, scale=scale, s_real=s),
+        out_shape=jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+        grid=(b * h, q_pad // block_q),
+        in_specs=[mat_tile_q, mat_full_s, mat_full_s, mat_tile_q,
+                  vec_spec_q, vec_spec_q],
+        out_specs=mat_tile_q,
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    block_k = 128
+    mat_tile_k = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+                              memory_space=pltpu.VMEM)
+    mat_full_q = pl.BlockSpec((1, q_pad, d), lambda i, j: (i, 0, 0),
+                              memory_space=pltpu.VMEM)
+    vec_full_q = pl.BlockSpec((1, q_pad), lambda i, j: (i, 0),
+                              memory_space=pltpu.VMEM)
+    dkb, dvb = pl.pallas_call(
+        functools.partial(_attn_dkv_kernel, scale=scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, s_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_pad, d), v.dtype),
+        ),
+        grid=(b * h, s_pad // block_k),
+        in_specs=[mat_tile_k, mat_tile_k, mat_full_q, mat_full_q,
+                  vec_full_q, vec_full_q],
+        out_specs=(mat_tile_k, mat_tile_k),
+        interpret=interpret,
+    )(kb, vb, qb, dob, lse, delta)
+
+    return (_from_bh(dqb, b, h, s, d), _from_bh(dkb, b, h, s, d),
+            _from_bh(dvb, b, h, s, d))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, block_q: int, interpret: bool):
+    out, _ = _flash_fwd_impl(q, k, v, block_q, interpret)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, block_q: int, interpret: bool):
+    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(block_q: int, interpret: bool, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, do, block_q, interpret)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
 def flash_attention(q, k, v, *, block_q: int = 128,
                     interpret: bool | None = None):
     """[B,S,H,D] self-attention, fused in VMEM. Drop-in for
-    ops/nn.dot_product_attention (non-causal)."""
+    ops/nn.dot_product_attention (non-causal), forward and backward —
+    differentiable via a recompute-based custom VJP."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_attention(q, k, v, block_q=min(block_q, _round_up(q.shape[1], 8)),
-                            interpret=interpret)
+    # 128-align the q tile in BOTH directions (round a small/odd block_q
+    # UP, cap at the padded sequence): the LSE rides the lane axis in the
+    # backward kernels and TPU lanes want multiples of 128. Padded rows
+    # are zero-filled and self-cancelling.
+    block_q = min(_round_up(block_q, 128), _round_up(q.shape[1], 128))
+    return _flash_attention(q, k, v, block_q, interpret)

@@ -15,7 +15,7 @@ def test_config_registry_covers_ladder():
         "mlp_mnist", "lenet5_mnist", "lenet5_fashion",
         "resnet20_cifar", "vit_tiny_cifar", "vit_tiny_cifar_ulysses",
         "vit_tiny_cifar_moe", "vit_tiny_cifar_pp", "vit_tiny_cifar_tp",
-        "vit_tiny_cifar_ring",
+        "vit_tiny_cifar_ring", "vit_tiny_cifar_flash",
     }
     # every §2.6 strategy is CLI-selectable from the ladder: DP (all),
     # TP, SP-ring, SP-ulysses, EP-moe, PP — one config each
