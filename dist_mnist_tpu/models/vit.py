@@ -67,6 +67,7 @@ class ViTTiny:
     # parallel/moe.py)
     n_experts: int = 4
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1  # 1 = Switch routing; >=2 = GShard-style top-k
     moe_aux_weight: float = 1e-2  # load-balance loss weight (Switch form);
     # the train step adds state["moe_aux"] to the loss
     scan_blocks: bool = False  # compile ONE block and lax.scan over stacked
@@ -81,6 +82,10 @@ class ViTTiny:
     # mesh's pipe axis equals N; on any other mesh the same model falls
     # back to the plain scan — one model, any topology.
     pipeline_microbatches: int = 8  # GPipe M; bubble = (N-1)/(M+N-1)
+    pipeline_circular: int = 0  # v>1: circular/interleaved schedule — each
+    # pipe rank holds v non-adjacent chunks of depth/(N*v) blocks; the
+    # fill/drain bubble shrinks from (N-1) stage-times to (N-1) chunk-times
+    # (parallel/pipeline.py). Needs depth % (N*v) == 0 and M % N == 0.
 
     def flops_per_example(self, sample_shape) -> float:
         """Analytic FORWARD FLOPs per example (matmul MACs x2; LN/softmax/
@@ -154,8 +159,15 @@ class ViTTiny:
             for i, block in enumerate(blocks):
                 params[f"block{i}"] = block
         # state carries the load-balance aux loss so the train step can add
-        # it to the objective (structure must match apply's output)
-        state = {"moe_aux": jnp.zeros(())} if self.mlp_impl == "moe" else {}
+        # it to the objective, plus routing-health stats surfaced as step
+        # metrics via the `_metric` contract (structure must match apply's
+        # output)
+        state = (
+            {"moe_aux": jnp.zeros(()),
+             "moe_drop_fraction_metric": jnp.zeros(()),
+             "moe_expert_load_metric": jnp.zeros((self.n_experts,))}
+            if self.mlp_impl == "moe" else {}
+        )
         return params, state
 
     def _attention(self, p, x):
@@ -184,19 +196,25 @@ class ViTTiny:
             )
         return nn.dense(p["out"], out.reshape(b, s, d))
 
+    def _moe_zero_stats(self):
+        return {"drop_fraction": jnp.zeros(()),
+                "expert_load": jnp.zeros((self.n_experts,))}
+
     def _block(self, p, x, layer_rng, use_dropout):
-        """One pre-LN transformer block; returns (x, moe_aux)."""
+        """One pre-LN transformer block; returns (x, moe_aux, moe_stats)."""
         y = nn.layer_norm(p["ln1"], x)
         x = x + self._attention(p["attn"], y)
         y = nn.layer_norm(p["ln2"], x)
         aux = jnp.zeros((), jnp.float32)
+        stats = self._moe_zero_stats() if self.mlp_impl == "moe" else None
         if self.mlp_impl == "moe":
             from dist_mnist_tpu.parallel.moe import moe_ffn_adaptive
 
             bb, ss, dd = y.shape
-            y, aux = moe_ffn_adaptive(
+            y, aux, stats = moe_ffn_adaptive(
                 p["moe"], y.reshape(bb * ss, dd),
                 capacity_factor=self.moe_capacity_factor,
+                top_k=self.moe_top_k,
             )
             y = y.reshape(bb, ss, dd)
         else:
@@ -204,7 +222,7 @@ class ViTTiny:
         if use_dropout:
             y = nn.dropout(layer_rng, y, self.dropout_rate, train=True)
         x = x + (y if self.mlp_impl == "moe" else nn.dense(p["mlp_out"], y))
-        return x, aux
+        return x, aux, stats
 
     def _pipe_axis_matches(self) -> bool:
         """True only when the ambient mesh's pipe axis equals the
@@ -243,9 +261,11 @@ class ViTTiny:
 
         mesh = get_abstract_mesh()
         n = mesh.shape[PIPE_AXIS]
-        if not self.scan_blocks or self.depth % n:
+        v = max(1, self.pipeline_circular)
+        if not self.scan_blocks or self.depth % (n * v):
             raise ValueError(
-                "block_pipeline needs scan_blocks=True and depth % stages == 0"
+                "block_pipeline needs scan_blocks=True and depth % "
+                "(stages * circular_chunks) == 0"
             )
         if use_dropout:
             raise ValueError(
@@ -254,32 +274,40 @@ class ViTTiny:
             )
         if self.mlp_impl == "moe":
             raise ValueError("block_pipeline supports dense MLP blocks only")
-        per_stage = self.depth // n
+        per_stage = self.depth // (n * v)
         stage_params = jax.tree.map(
-            lambda a: a.reshape((n, per_stage) + a.shape[1:]),
+            lambda a: a.reshape((n * v, per_stage) + a.shape[1:]),
             params["blocks"],
         )
 
         def stage_fn(p, xx):
             def body(carry, pp):
-                out, _ = self._block(pp, carry, None, False)
+                out, _, _ = self._block(pp, carry, None, False)
                 return out, None
 
             out, _ = jax.lax.scan(body, xx, p)
             return out
 
-        # GPipe output is independent of M, so adapt M down to the largest
-        # count this batch supports (B % M == 0 and the per-microbatch rows
-        # divisible by the data axis) — e.g. eval batches differ from the
-        # train batch and must not have to know the model's M
+        # Pipeline output is independent of M, so adapt M down to the
+        # largest count this batch supports (B % M == 0, per-microbatch rows
+        # divisible by the data axis, and — circular — M % stages == 0) —
+        # e.g. eval batches differ from the train batch and must not have
+        # to know the model's M
         from dist_mnist_tpu.cluster.mesh import DATA_AXIS
 
         b = x.shape[0]
         data_axis = mesh.shape.get(DATA_AXIS, 1)
         m = min(self.pipeline_microbatches, b)
-        while m > 1 and (b % m or (b // m) % data_axis):
+        while m > 1 and (b % m or (b // m) % data_axis
+                         or (v > 1 and m % n)):
             m -= 1
-        return pipeline_apply(stage_fn, stage_params, x, m, mesh)
+        if v > 1 and m % n:
+            raise ValueError(
+                f"pipeline_circular={v} needs a microbatch count divisible "
+                f"by the {n}-way pipe axis; none fits batch {b}"
+            )
+        return pipeline_apply(stage_fn, stage_params, x, m, mesh,
+                              circular_chunks=v)
 
     def apply(self, params, state, x, *, train=False, rng=None):
         x = x.astype(self.compute_dtype)
@@ -293,29 +321,44 @@ class ViTTiny:
         use_dropout = train and rng is not None and self.dropout_rate > 0
         rngs = (jax.random.split(rng, self.depth) if use_dropout
                 else jnp.zeros((self.depth,)))  # scannable dummy
+        is_moe = self.mlp_impl == "moe"
+        zero_aux = jnp.zeros((), jnp.float32)
+        zero_stats = self._moe_zero_stats() if is_moe else None
         if self.block_pipeline and self._pipe_axis_matches():
             x = self._pipelined_blocks(params, x, use_dropout)
-            aux_total = jnp.zeros((), jnp.float32)
+            aux_total, stats_total = zero_aux, zero_stats
         elif self.scan_blocks:
             def body(carry, xs):
-                x, aux_total = carry
+                x, aux_total, stats_total = carry
                 p, layer_rng = xs
-                x, aux = self._block(p, x, layer_rng, use_dropout)
-                return (x, aux_total + aux), None
+                x, aux, stats = self._block(p, x, layer_rng, use_dropout)
+                if is_moe:
+                    stats_total = jax.tree.map(jnp.add, stats_total, stats)
+                return (x, aux_total + aux, stats_total), None
 
-            (x, aux_total), _ = jax.lax.scan(
-                body, (x, jnp.zeros((), jnp.float32)),
+            (x, aux_total, stats_total), _ = jax.lax.scan(
+                body, (x, zero_aux, zero_stats),
                 (params["blocks"], rngs),
             )
         else:
-            aux_total = jnp.zeros((), jnp.float32)
+            aux_total, stats_total = zero_aux, zero_stats
             for i in range(self.depth):
-                x, aux = self._block(params[f"block{i}"], x, rngs[i],
-                                     use_dropout)
+                x, aux, stats = self._block(params[f"block{i}"], x, rngs[i],
+                                            use_dropout)
                 aux_total = aux_total + aux
+                if is_moe:
+                    stats_total = jax.tree.map(jnp.add, stats_total, stats)
         x = nn.layer_norm(params["final_ln"], x)
         pooled = x[:, 0] if self.pool == "cls" else jnp.mean(x, axis=1)
         logits = nn.dense(params["head"], pooled)
-        if self.mlp_impl == "moe":
-            state = {"moe_aux": self.moe_aux_weight * aux_total / self.depth}
+        if is_moe:
+            # stats are depth-means; `_metric` keys surface as step outputs
+            # (train/step.py) and flow into SummaryHook histograms
+            state = {
+                "moe_aux": self.moe_aux_weight * aux_total / self.depth,
+                "moe_drop_fraction_metric": stats_total["drop_fraction"]
+                / self.depth,
+                "moe_expert_load_metric": stats_total["expert_load"]
+                / self.depth,
+            }
         return logits.astype(jnp.float32), state

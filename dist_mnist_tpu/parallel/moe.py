@@ -6,11 +6,17 @@ whole technique hangs on — `lax.all_to_all` over an ICI axis — is a single
 compiled collective rather than the NCCL grouped send/recv a CUDA
 implementation hand-rolls.
 
-Design (top-1 "switch" routing, one expert per rank of the expert axis):
-- gate: tokens [T, D] -> scores [T, E]; each token routes to argmax expert
-  with its softmax prob as combine weight.
-- capacity: C = ceil(T/E * capacity_factor); tokens beyond an expert's
-  capacity are dropped (contribute zero — the standard switch behavior).
+Design (top-1 "switch" routing by default, GShard-style top-k via `top_k`;
+one expert per rank of the expert axis):
+- gate: tokens [T, D] -> scores [T, E]; each token routes to its k best
+  experts (k=1: raw softmax prob as combine weight; k>=2: the chosen
+  probs renormalized to sum to 1).
+- capacity: C = ceil(T/E * k * capacity_factor); assignments beyond an
+  expert's capacity are dropped (contribute zero — standard switch
+  behavior) — but never silently: every entry point also returns `stats`
+  = {drop_fraction, expert_load[E]} so routing health is observable
+  (the train step surfaces them as step metrics via the `_metric`
+  model-state contract, train/step.py).
 - dispatch: one-hot [T, E, C] mask -> [E, C, D] buffer -> tiled
   `all_to_all` so each rank receives the tokens bound for ITS expert from
   every rank -> expert FFN (dense relu dense) -> reverse `all_to_all` ->
@@ -57,28 +63,50 @@ def init_moe(key, dim: int, hidden: int, n_experts: int):
     }
 
 
-def _route(gate_w, x, n_experts: int, capacity: int):
-    """Top-1 routing tensors: combine [T,E,C] (prob on the chosen slot),
-    dispatch = combine != 0, plus the router statistics (f, p) the aux
-    load-balance loss is built from. f/p are LOCAL means over the tokens
-    seen here; the caller reduces them to global means before forming
-    aux = E * Σ_e f_e p_e (the Switch form) — aux is linear in neither, so
-    the reduction must happen on f/p, not on per-shard aux values."""
+def _route(gate_w, x, n_experts: int, capacity: int, top_k: int = 1):
+    """Top-k routing tensors: combine [T,E,C] (gate weight on the chosen
+    slot), dispatch = combine != 0, the router statistics (f, p) the aux
+    load-balance loss is built from, and routing-health stats. f/p are
+    LOCAL means over the tokens seen here; the caller reduces them to
+    global means before forming aux = E * Σ_e f_e p_e (the Switch form) —
+    aux is linear in neither, so the reduction must happen on f/p, not on
+    per-shard aux values.
+
+    top_k=1 is the Switch rule (combine weight = raw softmax prob of the
+    argmax expert); top_k>=2 is the GShard-style rule (a token rides to its
+    k best experts, weights = their probs renormalized to sum to 1). A
+    token's k experts are distinct, so the assignment matrix stays 0/1 and
+    one queue-position cumsum covers every k.
+
+    stats (health, not objective — VERDICT r3 weak 5: drops were silent):
+    - drop_fraction: dropped (over-capacity) assignments / total assignments
+    - expert_load:   [E] fraction of each expert's capacity C actually used
+    """
     scores = x @ gate_w  # [T, E]
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-    gate_val = jnp.max(probs, axis=-1)  # [T]
-    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
-    # position of each token in its expert's queue (exclusive cumsum)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [T, E], int-valued
-    in_cap = (pos < capacity).astype(jnp.float32) * onehot
-    pos_idx = jnp.sum(pos, axis=-1).astype(jnp.int32)  # [T]
-    slot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)  # [T, C]
-    dispatch = jnp.einsum("te,tc->tec", in_cap, slot)  # [T, E, C] 0/1
-    combine = dispatch * gate_val[:, None, None]
-    f = jnp.mean(onehot, axis=0)  # [E] fraction of tokens per expert
+    _, top_idx = jax.lax.top_k(probs, top_k)  # [T, K]
+    assigned = jnp.sum(  # [T, E] 0/1 — k distinct experts per token
+        jax.nn.one_hot(top_idx, n_experts, dtype=jnp.float32), axis=1
+    )
+    weights = probs * assigned  # [T, E]
+    if top_k > 1:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    # position of each (token, expert) assignment in the expert's queue
+    pos = jnp.cumsum(assigned, axis=0) * assigned - assigned  # [T, E]
+    in_cap = (pos < capacity).astype(jnp.float32) * assigned
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)  # [T, E, C]
+    dispatch = in_cap[:, :, None] * slot  # [T, E, C] 0/1
+    combine = dispatch * weights[:, :, None]
+    # f normalized by k so Σ_e f_e = 1 and the aux scale is k-invariant
+    f = jnp.mean(assigned, axis=0) / top_k  # [E]
     p = jnp.mean(probs, axis=0)  # [E] mean router prob per expert
-    return dispatch, combine, f, p
+    n_assigned = jnp.sum(assigned)
+    stats = {
+        "drop_fraction": 1.0 - jnp.sum(in_cap) / jnp.maximum(n_assigned, 1.0),
+        "expert_load": jnp.sum(in_cap, axis=0) / capacity,
+    }
+    return dispatch, combine, f, p, stats
 
 
 def _expert_ffn(w1, b1, w2, b2, tokens):
@@ -86,36 +114,44 @@ def _expert_ffn(w1, b1, w2, b2, tokens):
     return h @ w2 + b2
 
 
-def moe_ffn_dense(params, x, capacity_factor: float = 1.25):
+def moe_ffn_dense(params, x, capacity_factor: float = 1.25, top_k: int = 1):
     """All experts local — the einsum-only oracle (also the fallback on a
-    mesh without an expert axis)."""
+    mesh without an expert axis). Returns (out, aux, stats)."""
     t, _ = x.shape
     e = params["gate"].shape[-1]
-    capacity = max(1, int(-(-t // e) * capacity_factor))
-    dispatch, combine, f, p = _route(params["gate"], x, e, capacity)
+    capacity = max(1, int(-(-t // e) * top_k * capacity_factor))
+    dispatch, combine, f, p, stats = _route(params["gate"], x, e, capacity,
+                                            top_k)
     aux = e * jnp.sum(f * p)
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     expert_out = jax.vmap(_expert_ffn)(
         params["w1"], params["b1"], params["w2"], params["b2"], expert_in
     )
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
-    return out.astype(x.dtype), aux
+    return out.astype(x.dtype), aux, stats
 
 
 def moe_ffn_inner(params, x, axis_name: str = MODEL_AXIS,
-                  capacity_factor: float = 1.25, aux_axes=None):
+                  capacity_factor: float = 1.25, aux_axes=None,
+                  top_k: int = 1):
     """Inside shard_map: x [T_local, D] — tokens sharded over the expert
     axis too (canonical EP: the expert axis doubles as extra data sharding
     outside the MoE layer); params' expert leaves sliced to this rank
     (leading dim 1 — one expert per rank). `aux_axes`: every mesh axis the
     tokens are sharded over (default: just `axis_name`); router statistics
-    are pmean'd over them so aux equals the dense oracle's global value."""
+    are pmean'd over them so aux equals the dense oracle's global value.
+    Health stats are likewise pmean'd: with equal-sized token shards that
+    is the exact global drop fraction, and per-expert load averaged over
+    the per-shard queues (each shard routes its own T_local tokens with
+    capacity C — the EP capacity is per-shard by construction)."""
     n_experts = lax.axis_size(axis_name)
     t, _ = x.shape
-    capacity = max(1, int(-(-t // n_experts) * capacity_factor))
-    dispatch, combine, f, p = _route(params["gate"], x, n_experts, capacity)
+    capacity = max(1, int(-(-t // n_experts) * top_k * capacity_factor))
+    dispatch, combine, f, p, stats = _route(params["gate"], x, n_experts,
+                                            capacity, top_k)
     aux_axes = (axis_name,) if aux_axes is None else tuple(aux_axes)
     f, p = lax.pmean(f, aux_axes), lax.pmean(p, aux_axes)
+    stats = jax.tree.map(lambda a: lax.pmean(a, aux_axes), stats)
     aux = n_experts * jnp.sum(f * p)
     # [T,E,C] x [T,D] -> [E, C, D] send buffer (row e = tokens for expert e)
     send = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
@@ -133,10 +169,11 @@ def moe_ffn_inner(params, x, axis_name: str = MODEL_AXIS,
         split_axis=0, concat_axis=0, tiled=True,
     )
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
-    return out.astype(x.dtype), aux
+    return out.astype(x.dtype), aux, stats
 
 
-def moe_ffn_adaptive(params, x, capacity_factor: float = 1.25):
+def moe_ffn_adaptive(params, x, capacity_factor: float = 1.25,
+                     top_k: int = 1):
     """Mesh-adaptive entry used by models (mirrors ring/ulysses attention):
     expert-parallel over the ambient mesh's `model` axis when it is >1 AND
     matches the expert count, else the dense-local oracle — the same model
@@ -156,12 +193,12 @@ def moe_ffn_adaptive(params, x, capacity_factor: float = 1.25):
                 "the model axis to the expert count for expert parallelism",
                 e, axis,
             )
-        return moe_ffn_dense(params, x, capacity_factor)
-    return moe_ffn(params, x, mesh, MODEL_AXIS, capacity_factor)
+        return moe_ffn_dense(params, x, capacity_factor, top_k)
+    return moe_ffn(params, x, mesh, MODEL_AXIS, capacity_factor, top_k)
 
 
 def moe_ffn(params, x, mesh: Mesh, axis_name: str = MODEL_AXIS,
-            capacity_factor: float = 1.25):
+            capacity_factor: float = 1.25, top_k: int = 1):
     """Expert-parallel switch FFN over `mesh`'s `axis_name`; one expert per
     rank (E == axis size). x: [T, D] tokens, sharded jointly over
     `data` x the expert axis (T % (data*E) == 0); gate replicated; expert
@@ -180,10 +217,11 @@ def moe_ffn(params, x, mesh: Mesh, axis_name: str = MODEL_AXIS,
     run = jax.shard_map(
         partial(moe_ffn_inner, axis_name=axis_name,
                 capacity_factor=capacity_factor,
-                aux_axes=(DATA_AXIS, axis_name)),
+                aux_axes=(DATA_AXIS, axis_name), top_k=top_k),
         mesh=mesh,
         in_specs=(p_spec, tok_spec),
-        out_specs=(tok_spec, P()),
+        out_specs=(tok_spec, P(),
+                   {"drop_fraction": P(), "expert_load": P()}),
         check_vma=False,
     )
     return run(params, x)

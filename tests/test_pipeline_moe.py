@@ -90,6 +90,64 @@ class TestPipeline:
             g_seq_stacked,
         )
 
+    @pytest.mark.slow
+    def test_circular_matches_sequential(self, pipe_mesh):
+        """The interleaved schedule (v chunks per rank, stage c*S+s on rank
+        s) must equal sequential application exactly — including the wrap
+        hop where retire and ingest share one ring transfer."""
+        dim, batch, n_stages, v = 16, 32, 4, 2
+        stages = _make_stages(jax.random.PRNGKey(6), n_stages * v, dim)
+        x = jax.random.normal(jax.random.PRNGKey(7), (batch, dim))
+
+        expected = x
+        for p in stages:
+            expected = _stage_fn(p, expected)
+
+        stacked = stack_stage_params(stages)
+        got = pipeline_apply(_stage_fn, stacked, x, num_microbatches=8,
+                             mesh=pipe_mesh, circular_chunks=v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.slow
+    def test_circular_differentiable(self, pipe_mesh):
+        dim, batch, n_stages, v = 8, 16, 4, 2
+        stages = _make_stages(jax.random.PRNGKey(8), n_stages * v, dim)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.PRNGKey(9), (batch, dim))
+
+        def loss(sp):
+            y = pipeline_apply(_stage_fn, sp, x, num_microbatches=4,
+                               mesh=pipe_mesh, circular_chunks=v)
+            return jnp.sum(y**2)
+
+        def loss_seq(params_list):
+            y = x
+            for p in params_list:
+                y = _stage_fn(p, y)
+            return jnp.sum(y**2)
+
+        g_pipe = jax.grad(loss)(stacked)
+        g_seq = stack_stage_params(jax.grad(loss_seq)(stages))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            ),
+            g_pipe, g_seq,
+        )
+
+    def test_circular_guards(self, pipe_mesh):
+        stages = _make_stages(jax.random.PRNGKey(10), 8, 8)
+        stacked = stack_stage_params(stages)
+        # microbatch count not divisible by rank count
+        with pytest.raises(ValueError, match="rank-width groups"):
+            pipeline_apply(_stage_fn, stacked, jnp.ones((18, 8)), 6,
+                           pipe_mesh, circular_chunks=2)
+        # wrong stage count for S*v
+        with pytest.raises(ValueError, match="circular_chunks"):
+            pipeline_apply(_stage_fn, stacked, jnp.ones((16, 8)), 4,
+                           pipe_mesh, circular_chunks=3)
+
     def test_under_jit(self, pipe_mesh):
         dim, batch = 8, 16
         stages = _make_stages(jax.random.PRNGKey(4), 4, dim)
@@ -124,11 +182,13 @@ class TestMoE:
         params = init_moe(jax.random.PRNGKey(0), dim=16, hidden=32,
                           n_experts=4)
         x = jax.random.normal(jax.random.PRNGKey(1), (64, 16))
-        out, aux = moe_ffn_dense(params, x)
+        out, aux, stats = moe_ffn_dense(params, x)
         assert out.shape == x.shape
         assert np.isfinite(float(aux))
         # aux of a perfectly uniform router is 1.0; any router is >= 1 - eps
         assert float(aux) >= 0.99
+        assert 0.0 <= float(stats["drop_fraction"]) <= 1.0
+        assert stats["expert_load"].shape == (4,)
 
     @pytest.mark.slow
     def test_distributed_matches_dense(self, ep_mesh):
@@ -138,8 +198,13 @@ class TestMoE:
         params = init_moe(jax.random.PRNGKey(2), dim=dim, hidden=32,
                           n_experts=4)
         x = jax.random.normal(jax.random.PRNGKey(3), (tokens, dim))
-        dense_out, dense_aux = moe_ffn_dense(params, x, capacity_factor=4.0)
-        ep_out, ep_aux = moe_ffn(params, x, ep_mesh, capacity_factor=4.0)
+        dense_out, dense_aux, dense_stats = moe_ffn_dense(
+            params, x, capacity_factor=4.0)
+        ep_out, ep_aux, ep_stats = moe_ffn(params, x, ep_mesh,
+                                           capacity_factor=4.0)
+        # generous capacity: neither path drops anything, and both SAY so
+        assert float(dense_stats["drop_fraction"]) == 0.0
+        assert float(ep_stats["drop_fraction"]) == 0.0
         np.testing.assert_allclose(
             np.asarray(ep_out), np.asarray(dense_out), rtol=1e-5, atol=1e-5
         )
@@ -157,7 +222,7 @@ class TestMoE:
         x = jax.random.normal(jax.random.PRNGKey(5), (32, 8))
 
         def loss(p):
-            out, aux = moe_ffn(p, x, ep_mesh, capacity_factor=2.0)
+            out, aux, _ = moe_ffn(p, x, ep_mesh, capacity_factor=2.0)
             return jnp.sum(out**2) + 0.01 * aux
 
         g = jax.grad(loss)(params)
@@ -178,10 +243,60 @@ class TestMoE:
             np.stack([np.full((8,), 10.0), np.full((8,), -10.0)], axis=1)
         )
         x = jnp.abs(jax.random.normal(jax.random.PRNGKey(7), (16, 8))) + 0.1
-        out, _ = moe_ffn_dense(params, x, capacity_factor=0.5)
+        out, _, stats = moe_ffn_dense(params, x, capacity_factor=0.5)
         # capacity = ceil(16/2) * 0.5 = 4 -> tokens 4.. dropped
         dropped = np.asarray(out[4:])
         np.testing.assert_allclose(dropped, np.zeros_like(dropped), atol=0)
+        # ...and the health stats PIN the drop: 12 of 16 assignments lost,
+        # expert 0's queue full, expert 1 idle (VERDICT r3 weak 5)
+        np.testing.assert_allclose(float(stats["drop_fraction"]), 12 / 16)
+        np.testing.assert_allclose(np.asarray(stats["expert_load"]),
+                                   [1.0, 0.0])
+
+    @pytest.mark.slow
+    def test_top2_distributed_matches_dense(self, ep_mesh):
+        """GShard-style top-2: EP dispatch == dense oracle with generous
+        capacity, and the combine weights renormalize over the chosen two
+        (output is a convex mix of two expert outputs per token)."""
+        dim, tokens = 16, 64
+        params = init_moe(jax.random.PRNGKey(9), dim=dim, hidden=32,
+                          n_experts=4)
+        x = jax.random.normal(jax.random.PRNGKey(10), (tokens, dim))
+        dense_out, dense_aux, dense_stats = moe_ffn_dense(
+            params, x, capacity_factor=4.0, top_k=2)
+        ep_out, ep_aux, ep_stats = moe_ffn(params, x, ep_mesh,
+                                           capacity_factor=4.0, top_k=2)
+        np.testing.assert_allclose(np.asarray(ep_out), np.asarray(dense_out),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(float(ep_aux), float(dense_aux), rtol=1e-5)
+        assert float(dense_stats["drop_fraction"]) == 0.0
+        # top-2 routes 2 assignments per token
+        cap = 2 * 4 * int(np.ceil(tokens / 4))  # C per expert at cf=4, k=2
+        assert float(jnp.sum(dense_stats["expert_load"])) * cap == pytest.approx(
+            2 * tokens)
+
+    @pytest.mark.slow
+    def test_tight_capacity_divergence_quantified(self):
+        """capacity_factor=0.5 vs the no-drop oracle: the divergence is
+        real but bounded — exactly the degradation the drop_fraction metric
+        exists to surface (a silent-drop regression would show here)."""
+        params = init_moe(jax.random.PRNGKey(11), dim=16, hidden=32,
+                          n_experts=4)
+        x = jax.random.normal(jax.random.PRNGKey(12), (64, 16))
+        full, _, full_stats = moe_ffn_dense(params, x, capacity_factor=4.0)
+        tight, _, tight_stats = moe_ffn_dense(params, x, capacity_factor=0.5)
+        assert float(full_stats["drop_fraction"]) == 0.0
+        drop = float(tight_stats["drop_fraction"])
+        assert drop > 0.0  # tight capacity really drops
+        # dropped tokens output EXACTLY zero; their fraction is what the
+        # metric reports (kept rows match the oracle up to reduction-order
+        # float noise — the combine contraction's slot dim differs)
+        zero_rows = np.mean(np.abs(np.asarray(tight)).max(axis=-1) == 0.0)
+        assert zero_rows == pytest.approx(drop, abs=1e-6)
+        kept = np.abs(np.asarray(tight)).max(axis=-1) > 0.0
+        np.testing.assert_allclose(np.asarray(tight)[kept],
+                                   np.asarray(full)[kept],
+                                   rtol=2e-5, atol=2e-6)
 
     @pytest.mark.slow
     def test_expert_count_mismatch_raises(self, ep_mesh):
@@ -330,6 +445,17 @@ class TestPipelineInViT:
             jax.block_until_ready(pp_logits)
         np.testing.assert_allclose(np.asarray(ref_logits),
                                    np.asarray(pp_logits),
+                                   rtol=2e-4, atol=2e-5)
+        # circular schedule (2 ranks x 2 chunks of 1 block) == same logits
+        circ = get_model("vit_tiny", block_pipeline=2, pipeline_circular=2,
+                         pipeline_microbatches=4, **self.KW)
+        with activate(mesh):
+            c_logits, _ = jax.jit(
+                lambda p: circ.apply(p, state, x, train=False)
+            )(params)
+            jax.block_until_ready(c_logits)
+        np.testing.assert_allclose(np.asarray(ref_logits),
+                                   np.asarray(c_logits),
                                    rtol=2e-4, atol=2e-5)
 
     def test_pipelined_grads_flow(self):

@@ -35,6 +35,32 @@ def _setup(mesh, batch=32, seed=0):
     return model, opt, state, step, dev_batch, batch_np
 
 
+def test_model_state_metric_contract(mesh8):
+    """`_metric` entries of model_state surface as step outputs with the
+    suffix stripped — the MoE routing-health channel (train/step.py)."""
+    from dist_mnist_tpu.cluster.mesh import activate
+
+    model = get_model("vit_tiny", depth=1, dim=32, heads=4, patch=8,
+                      pool="mean", mlp_impl="moe", n_experts=2,
+                      moe_capacity_factor=8.0, compute_dtype=jnp.float32)
+    opt = optim.adam(1e-3)
+    rng = np.random.default_rng(5)
+    batch_np = {
+        "image": rng.integers(0, 255, (16, 32, 32, 3), dtype=np.uint8),
+        "label": rng.integers(0, 10, (16,), dtype=np.int32),
+    }
+    with activate(mesh8):
+        state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                   batch_np["image"][:1])
+        state = shard_train_state(state, mesh8)
+        step = make_train_step(model, opt, mesh8, donate=False)
+        _, out = step(state, shard_batch(batch_np, mesh8))
+    assert 0.0 <= float(out["moe_drop_fraction"]) <= 1.0
+    assert out["moe_expert_load"].shape == (2,)
+    # generous capacity -> nothing dropped, and the metric says so
+    assert float(out["moe_drop_fraction"]) == 0.0
+
+
 def test_loss_decreases(mesh8):
     _, _, state, step, batch, _ = _setup(mesh8)
     with mesh8:
