@@ -245,7 +245,10 @@ def ladder_batch(cfg, n_chips: int) -> tuple[int, str]:
     is preserved instead of cramming the pod-slice batch into one chip's
     HBM (measured: vit_tiny_cifar's batch-1024 step needs 19.4G vs the
     v5e's 15.75G). Returns (batch, provenance_note)."""
-    if n_chips < cfg.ladder_devices:
+    if n_chips != cfg.ladder_devices:
+        # both directions: a smaller box must not cram the pod-slice batch
+        # into one chip's HBM, and a BIGGER box must not shrink the per-chip
+        # batch (which would read as a fake per-chip regression vs anchors)
         per_chip = max(1, cfg.batch_size // cfg.ladder_devices)
         return per_chip * n_chips, (
             f"per-chip geometry of the {cfg.ladder_devices}-chip ladder "
@@ -282,9 +285,19 @@ def bench_config(name: str, n_timed: int) -> int:
         mesh = make_mesh(cfg.mesh)  # the config's declared topology
         mesh_note = "config"
     except ValueError:
-        # e.g. an 8-way config on this 1-chip box: run on what exists
+        # e.g. an 8-way config on this 1-chip box: run on what exists. The
+        # data-only fallback mesh collapses the strategy axes (model/seq/
+        # pipe) to 1, so a non-DP rule set cannot measure its strategy —
+        # bench DP and SAY SO instead of mislabeling (ADVICE r3 #1).
+        from dist_mnist_tpu.parallel.sharding import DP_RULES
+
         mesh = make_mesh(MeshSpec(data=-1))
         mesh_note = f"fallback (config wants {cfg.mesh}, have {jax.device_count()})"
+        if cfg.sharding_rules != "dp":
+            rules = DP_RULES
+            mesh_note += (
+                f"; strategy axes unavailable — benched as DP, not "
+                f"{cfg.sharding_rules!r}")
     n_chips = mesh.devices.size
     global_batch, batch_note = ladder_batch(cfg, n_chips)
     dataset = load_dataset(cfg.dataset, "/tmp/mnist-data", seed=cfg.seed)

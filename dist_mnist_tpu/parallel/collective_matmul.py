@@ -40,6 +40,10 @@ def allgather_matmul(x, w, mesh: Mesh, axis: str = "model"):
     rotating the x shards around the ring between chunk matmuls.
     """
     n = mesh.shape[axis]
+    assert x.shape[0] % n == 0, \
+        f"x rows {x.shape[0]} not divisible by {axis}={n}"
+    assert w.shape[1] % n == 0, \
+        f"w cols {w.shape[1]} not divisible by {axis}={n}"
 
     def body(x_local, w_local):
         m = x_local.shape[0]

@@ -35,6 +35,16 @@ class ShardingRules:
                 return P(*(pad + tuple(axes)))
         return P()  # replicated
 
+    def match_count(self, tree) -> int:
+        """How many leaves of `tree` any rule matches (0 on an empty rule
+        set). A non-empty rule set matching NOTHING means the named strategy
+        silently degrades to replication — callers should refuse."""
+        _, _, paths = _paths(tree)
+        return sum(
+            1 for p in paths
+            if any(re.search(pattern, p) for pattern, _ in self.rules)
+        )
+
 
 # Pure data parallelism: every param replicated.
 DP_RULES = ShardingRules()
@@ -90,6 +100,18 @@ def shard_train_state(state, mesh: Mesh, rules: ShardingRules = DP_RULES):
     adam.py:189-203) inherit their param's spec: slot math is elementwise,
     so colocating slot shards with param shards makes the update fully
     local, exactly as slot-colocated-with-variable did on the PS.
+
+    Refuses a non-trivial rule set that matches NO parameter: that is the
+    silent-wrong-strategy failure `resolve_rules` exists to prevent (a
+    `sharding_rules="tp"` config over a conv model would otherwise train
+    fully replicated under TP's name).
     """
+    if rules.rules and rules.match_count(state.params) == 0:
+        raise ValueError(
+            f"sharding rules {tuple(p for p, _ in rules.rules)} matched no "
+            "parameter path — the model would silently train fully "
+            "replicated (DP) under this strategy's name. Pick rules that "
+            "match this model's params, or use DP_RULES explicitly."
+        )
     sharded = tree_sharding(state, mesh, rules)
     return jax.device_put(state, sharded)

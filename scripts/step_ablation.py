@@ -114,14 +114,30 @@ def main():
         # scan has a data dependency chain without touching params.
         # train=True with the SAME fixed rng as the grad slices, so the
         # fwd/fwd_bwd delta isolates ONLY the backward pass (dropout's
-        # forward cost would otherwise be double-counted into "backward")
+        # forward cost would otherwise be double-counted into "backward").
+        # The carry is folded into the INPUT (x + 1e-30*acc): the forward is
+        # then not loop-invariant, so while-loop LICM cannot hoist it out of
+        # the scan and time an empty loop (ADVICE r3 #2).
         def fwd_body(acc):
-            logits, _ = model.apply(state.params, state.model_state, x_fixed,
+            x = x_fixed + 1e-30 * acc
+            logits, _ = model.apply(state.params, state.model_state, x,
                                     train=True, rng=key)
             return acc + losses.softmax_cross_entropy(logits, y_fixed)
 
         emit("fwd", timed_scan(fwd_body, jnp.zeros(()), args.chunk,
                                args.chunks))
+        # hoist-detector: per-step time must be chunk-length-invariant; a
+        # hoisted (loop-invariant) body would show ~chunk x inflation here
+        half = max(1, args.chunk // 2)
+        secs_half = timed_scan(fwd_body, jnp.zeros(()), half, 2)
+        ratio = secs_half / max(results["fwd"], 1e-12)
+        print(json.dumps({"variant": "fwd_sanity_half_chunk",
+                          "us_per_step": round(secs_half * 1e6, 1),
+                          "ratio_vs_fwd": round(ratio, 2),
+                          "ok": bool(0.5 < ratio < 1.5)}), flush=True)
+        # upper bound 1.5, NOT 2.0: a hoisted (empty) loop times the same
+        # wall per chunk regardless of length, so its half-chunk per-step
+        # ratio sits at exactly 2.0 — the window must exclude it
 
         # --- fwd_bwd: + grad; carry = params so bwd output feeds the chain
         def loss_of(params, key):
@@ -131,8 +147,10 @@ def main():
 
         def fwd_bwd_body(params):
             g = jax.grad(loss_of)(params, key)
-            # fold the grads back in (scaled to ~0) to keep the chain honest
-            return jax.tree.map(lambda p, gg: p - 0.0 * gg, params, g)
+            # fold the grads back in, scaled by a tiny NONZERO constant: the
+            # chain stays honest and `- 0.0 * g` can't be algebraically
+            # simplified into dead-coding the backward (ADVICE r3 #2)
+            return jax.tree.map(lambda p, gg: p - 1e-30 * gg, params, g)
 
         emit("fwd_bwd", timed_scan(fwd_bwd_body, state.params, args.chunk,
                                    args.chunks))

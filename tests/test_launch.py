@@ -73,10 +73,12 @@ def test_two_process_training(tmp_path):
 
 @pytest.mark.slow
 def test_two_process_device_pipeline(tmp_path):
-    """The fused device input path on a REAL 2-process cluster: dataset
-    rows sharded across BOTH processes' devices (make_array_from_callback —
-    device_put can't reach non-addressable devices), sampling in-program,
-    scan-chunked loop. Both processes must converge identically."""
+    """The fused device input path on a REAL 2-process × 4-device cluster
+    (8 global devices): dataset rows sharded across BOTH processes' devices
+    (make_array_from_callback — device_put can't reach non-addressable
+    devices), sampling in-program, scan-chunked loop. Both processes must
+    converge identically (VERDICT r3 next-9: sharded residency + bound-data
+    jit args across processes at the widest per-process device count)."""
     import contextlib
     import io
 
@@ -103,7 +105,7 @@ def test_two_process_device_pipeline(tmp_path):
                 "--scan_chunk=3",
             ],
             platform="cpu",
-            devices_per_process=2,
+            devices_per_process=4,
         )
     log = buf.getvalue()
     assert rc == 0, log
