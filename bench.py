@@ -317,7 +317,8 @@ def bench_config(name: str, n_timed: int) -> int:
         run = make_scanned_train_fn(model, optimizer, mesh, dd,
                                     global_batch, chunk, loss_fn=loss_fn,
                                     rules=rules,
-                                    remat=cfg.remat, augment=cfg.augment)
+                                    remat=cfg.remat, augment=cfg.augment,
+                                    remat_policy=cfg.remat_policy)
         # timed_chunks = the axon-hardened device_get stop-clock
         dt, state, _ = timed_chunks(run, state, max(1, n_timed // chunk))
         n_steps = max(1, n_timed // chunk) * chunk

@@ -60,11 +60,14 @@ def _refill_metric_state(restored, target_state):
     return dataclasses.replace(restored, model_state={**ms, **missing})
 
 
-def _flip_block_layouts(state):
+def _flip_block_layouts(state, probe_only: bool = False):
     """A copy of `state` with every ViT-block-layout dict (params and the
     optimizer slots that mirror them) converted to the OTHER layout via
     models.vit.convert_block_layout; None when the state contains no block
-    layout at all (the mismatch is then something else — re-raise)."""
+    layout at all (the mismatch is then something else — re-raise).
+    `probe_only=True` answers "would a flip apply?" WITHOUT materializing
+    the converted copy (the conversion allocates a transient ~2x of
+    params + optimizer slots on device)."""
     import dataclasses
     import re
 
@@ -72,15 +75,20 @@ def _flip_block_layouts(state):
 
     found = False
 
-    def rec(node):
-        nonlocal found
-        if isinstance(node, dict):
-            if "blocks" in node or any(
+    def is_block_dict(node):
+        return isinstance(node, dict) and (
+            "blocks" in node or any(
                 isinstance(k, str) and re.fullmatch(r"block\d+", k)
                 for k in node
-            ):
-                found = True
-                return convert_block_layout(node)
+            )
+        )
+
+    def rec(node):
+        nonlocal found
+        if is_block_dict(node):
+            found = True
+            return node if probe_only else convert_block_layout(node)
+        if isinstance(node, dict):
             return {k: rec(v) for k, v in node.items()}
         if isinstance(node, tuple):  # chained optimizer states
             vals = (rec(v) for v in node)
@@ -90,13 +98,16 @@ def _flip_block_layouts(state):
             return [rec(v) for v in node]
         return node
 
-    flipped = dataclasses.replace(
-        state,
-        params=rec(state.params),
-        model_state=rec(state.model_state),
-        opt_state=rec(state.opt_state),
+    converted = (rec(state.params), rec(state.model_state),
+                 rec(state.opt_state))
+    if not found:
+        return None
+    if probe_only:
+        return True
+    return dataclasses.replace(
+        state, params=converted[0], model_state=converted[1],
+        opt_state=converted[2],
     )
-    return flipped if found else None
 
 
 class CheckpointManager:
@@ -183,20 +194,27 @@ class CheckpointManager:
         2. ViT scanned<->unrolled block layout flip;
         3. both at once."""
         stripped, metric_keys = _strip_metric_state(target_state)
-        flipped = _flip_block_layouts(target_state)
+        has_blocks = _flip_block_layouts(target_state, probe_only=True)
+        # alt targets built LAZILY: the flip materializes a transient ~2x
+        # copy of params + optimizer slots on device (stack/slice ops), so
+        # it must not run unless its attempt is actually tried
         attempts = []
         if metric_keys:
             attempts.append(("without the _metric model-state entries "
-                             f"{sorted(metric_keys)}", stripped, False))
-        if flipped is not None:
-            attempts.append(("in the flipped ViT block layout", flipped,
+                             f"{sorted(metric_keys)}",
+                             lambda: stripped, False))
+        if has_blocks:
+            attempts.append(("in the flipped ViT block layout",
+                             lambda: _flip_block_layouts(target_state),
                              True))
-        if metric_keys and flipped is not None:
+        if metric_keys and has_blocks:
             attempts.append(("flipped layout + no _metric entries",
-                             _strip_metric_state(flipped)[0], True))
-        for what, alt_target, is_flipped in attempts:
+                             lambda: _strip_metric_state(
+                                 _flip_block_layouts(target_state))[0],
+                             True))
+        for what, make_target, is_flipped in attempts:
             try:
-                restored = self._restore_into(step, alt_target)
+                restored = self._restore_into(step, make_target())
             except Exception:
                 continue
             log.warning(

@@ -65,6 +65,10 @@ flags.DEFINE_boolean("profile", False, "trace a window of steps to logdir")
 flags.DEFINE_string("prng_impl", None,
                     "PRNG impl override: threefry2x32 (default) | rbg "
                     "(faster dropout masks on TPU; see configs.py)")
+flags.DEFINE_string("remat_policy", None,
+                    "remat policy override when the config sets remat: "
+                    "dots_no_batch (default) | save_attn | dots | nothing "
+                    "(train/step.py REMAT_POLICIES)")
 flags.DEFINE_integer("eval_every", None, "eval cadence in steps; 0 disables "
                      "(None = config value)")
 flags.DEFINE_integer("log_every", None, "log/summary cadence in steps")
@@ -239,19 +243,20 @@ def _run_config(
                 run = make_scanned_train_fn(
                     model, optimizer, mesh, dd, cfg.batch_size, scan_chunk,
                     loss_fn=loss_fn, rules=rules, remat=cfg.remat,
-                    augment=cfg.augment,
+                    augment=cfg.augment, remat_policy=cfg.remat_policy,
                 )
             else:
                 run = make_fused_train_step(
                     model, optimizer, mesh, dd, cfg.batch_size,
                     loss_fn=loss_fn, rules=rules, remat=cfg.remat,
-                    augment=cfg.augment,
+                    augment=cfg.augment, remat_policy=cfg.remat_policy,
                 )
             step_fn = lambda state, _batch: run(state)
         else:
             step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn,
                                       rules=rules, remat=cfg.remat,
-                                      augment=cfg.augment)
+                                      augment=cfg.augment,
+                                      remat_policy=cfg.remat_policy)
         eval_step = make_eval_step(model, mesh)
         eval_fn = lambda s: evaluate(
             eval_step, s, dataset.test_images, dataset.test_labels, mesh
@@ -350,6 +355,8 @@ def _apply_flag_overrides(cfg):
         over["mesh"] = MeshSpec(**{k: int(v) for k, v in kv.items()})
     if FLAGS.prng_impl:
         over["prng_impl"] = FLAGS.prng_impl
+    if FLAGS.remat_policy:
+        over["remat_policy"] = FLAGS.remat_policy
     return dataclasses.replace(cfg, **over) if over else cfg
 
 

@@ -43,6 +43,10 @@ class Config:
     # masks; rbg trades cross-backend bit-reproducibility for speed
     # (determinism WITHIN a backend is preserved)
     remat: bool = False  # jax.checkpoint the forward (HBM <-> FLOPs trade)
+    remat_policy: str = "dots_no_batch"  # what remat saves vs recomputes:
+    # dots_no_batch | save_attn (keep per-block attention outputs — stops
+    # the O(S^2) backward recompute) | dots | nothing
+    # (train/step.py REMAT_POLICIES)
     augment: bool = False  # on-device pad-crop-flip (data/augment.py)
     eval_every: int = 1000
     log_every: int = 100

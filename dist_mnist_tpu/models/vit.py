@@ -194,6 +194,13 @@ class ViTTiny:
                 f"unknown attention_impl {self.attention_impl!r}; "
                 "use 'xla' | 'flash' | 'ring' | 'ulysses'"
             )
+        if self.attention_impl == "flash":
+            # same save_attn remat tag the other impls get inside
+            # ops/nn.dot_product_attention (ring/ulysses route through it;
+            # tagging them here too would double the per-block save)
+            from jax.ad_checkpoint import checkpoint_name
+
+            out = checkpoint_name(out, "attn_out")
         return nn.dense(p["out"], out.reshape(b, s, d))
 
     def _moe_zero_stats(self):

@@ -213,11 +213,20 @@ def multi_head_attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
 
 
 def dot_product_attention(q, k, v) -> jax.Array:
-    """[B, S, H, Dh] -> [B, S, H, Dh]; accumulation in f32 for stability."""
+    """[B, S, H, Dh] -> [B, S, H, Dh]; accumulation in f32 for stability.
+
+    The result is tagged `checkpoint_name("attn_out")` so the `save_attn`
+    remat policy (train/step.py REMAT_POLICIES) can keep it in HBM instead
+    of recomputing the whole O(S^2) score/softmax/apply chain in the
+    backward pass. Outside jax.checkpoint the tag is an identity no-op."""
+    from jax.ad_checkpoint import checkpoint_name
+
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    return checkpoint_name(
+        jnp.einsum("bhqk,bkhd->bqhd", weights, v), "attn_out"
+    )
 
 
 def flatten(x: jax.Array) -> jax.Array:

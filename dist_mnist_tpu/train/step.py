@@ -28,6 +28,38 @@ from dist_mnist_tpu.train.state import TrainState
 
 LossFn = Callable[..., jax.Array]
 
+# Named rematerialization policies (`Config.remat_policy`). All are
+# numerically identical — they trade backward-pass recompute FLOPs against
+# activation HBM differently:
+#   dots_no_batch  save weight-matmul outputs, recompute BATCHED dots (the
+#                  O(S^2) attention score/apply einsums) — the flash-style
+#                  default; lowest memory of the dot-saving family
+#   save_attn      dots_no_batch PLUS the tensors tagged
+#                  `checkpoint_name("attn_out")` (the per-block attention
+#                  context, ops/nn.py + models/vit.py) — stops recomputing
+#                  the whole O(S^2) chain at the cost of one [B,S,D] save
+#                  per block; the ViT-MFU attribution's candidate fix
+#   dots           save ALL dot outputs incl. batched (scores+apply saved)
+#   nothing        recompute everything (maximum memory savings)
+REMAT_POLICIES = {
+    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "save_attn": jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names("attn_out"),
+    ),
+    "dots": jax.checkpoint_policies.dots_saveable,
+    "nothing": jax.checkpoint_policies.nothing_saveable,
+}
+
+
+def resolve_remat_policy(name: str):
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; use one of "
+            f"{sorted(REMAT_POLICIES)}"
+        )
+    return REMAT_POLICIES[name]
+
 
 def model_aux_loss(model_state):
     """THE aux-objective contract: any top-level SCALAR entry of
@@ -47,16 +79,16 @@ def model_aux_loss(model_state):
 
 def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
                 dropout_key, *, with_grad_norm: bool = False,
-                remat: bool = False, augment: bool = False):
+                remat: bool = False, augment: bool = False,
+                remat_policy: str = "dots_no_batch"):
     """The shared fwd+bwd+update body every step variant compiles.
 
     `remat=True` wraps the forward in `jax.checkpoint`: activations are
     recomputed in the backward pass instead of living in HBM across it —
     the FLOPs-for-bandwidth trade deep models need to fit a chip (e.g. ViT
-    on long token sequences). Policy: `dots_with_no_batch_dims_saveable` —
-    weight-matmul outputs are saved, while BATCHED dots (attention
-    score/value einsums, the O(S^2) terms) are recomputed; that is the
-    flash-attention-style trade this flag exists for.
+    on long token sequences). `remat_policy` selects WHAT is saved vs
+    recomputed (REMAT_POLICIES above); the default recomputes the batched
+    attention dots, `save_attn` keeps them.
     """
     # Structural guards (SURVEY.md §5.2): trace-time only — zero runtime
     # cost under jit. The reference's analogue was graph finalization +
@@ -83,7 +115,7 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
 
     if remat:
         forward = jax.checkpoint(
-            forward, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            forward, policy=resolve_remat_policy(remat_policy)
         )
 
     def loss_of(params):
@@ -133,7 +165,8 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
 
 
 def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
-                    remat: bool = False, augment: bool = False):
+                    remat: bool = False, augment: bool = False,
+                    remat_policy: str = "dots_no_batch"):
     """One step with batch sampling inside the program (fused-input body).
     The resident dataset arrays arrive as EXPLICIT args (`data`), never as
     closed-over constants — a multi-process global array may not be
@@ -147,7 +180,8 @@ def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
         batch = device_dataset.sample_arrays(sample_key, batch_size,
                                              images, labels)
         return _train_core(model, optimizer, loss_fn, state, batch,
-                           dropout_key, remat=remat, augment=augment)
+                           dropout_key, remat=remat, augment=augment,
+                           remat_policy=remat_policy)
 
     return one_step
 
@@ -217,6 +251,7 @@ def make_train_step(
     with_grad_norm: bool = False,
     remat: bool = False,
     augment: bool = False,
+    remat_policy: str = "dots_no_batch",
 ):
     """Build `step(state, batch) -> (state, metrics)` jitted over `mesh`.
 
@@ -231,7 +266,8 @@ def make_train_step(
         dropout_key = jax.random.fold_in(state.rng, state.step)
         return _train_core(model, optimizer, loss_fn, state, batch,
                            dropout_key, with_grad_norm=with_grad_norm,
-                           remat=remat, augment=augment)
+                           remat=remat, augment=augment,
+                           remat_policy=remat_policy)
 
     return _lazy_jit(step, mesh, rules, donate, n_args=2)
 
@@ -247,6 +283,7 @@ def make_fused_train_step(
     rules: ShardingRules = DP_RULES,
     remat: bool = False,
     augment: bool = False,
+    remat_policy: str = "dots_no_batch",
 ):
     """`step(state) -> (state, metrics)` with BATCH SAMPLING INSIDE the
     compiled program (data/pipeline.DeviceDataset): the host does zero
@@ -255,7 +292,8 @@ def make_fused_train_step(
     bench-path step; semantics = with-replacement sampling (vs the hooked
     loop's shuffled epochs)."""
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
-                               batch_size, remat=remat, augment=augment)
+                               batch_size, remat=remat, augment=augment,
+                               remat_policy=remat_policy)
     return _lazy_jit(one_step, mesh, rules, donate=True,
                      bound_data=device_dataset.arrays)
 
@@ -272,6 +310,7 @@ def make_scanned_train_fn(
     rules: ShardingRules = DP_RULES,
     remat: bool = False,
     augment: bool = False,
+    remat_policy: str = "dots_no_batch",
 ):
     """`run(state) -> (state, metrics)` executing `chunk` fused steps in ONE
     XLA program via `lax.scan` — zero per-step Python dispatch, the
@@ -281,7 +320,8 @@ def make_scanned_train_fn(
     per-step loop; this removes that ceiling."""
 
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
-                               batch_size, remat=remat, augment=augment)
+                               batch_size, remat=remat, augment=augment,
+                               remat_policy=remat_policy)
 
     def run_chunk(state: TrainState, data):
         state, outs = jax.lax.scan(

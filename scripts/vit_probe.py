@@ -66,6 +66,11 @@ def main():
         ("ladder_point", {}, dict(remat=cfg.remat, augment=cfg.augment),
          per_chip),
         ("no_remat", {}, dict(remat=False, augment=cfg.augment), per_chip),
+        # remat with the attention outputs SAVED (the suspected fix for the
+        # remat-recompute share of the MFU gap: ~+50% backward FLOPs)
+        ("remat_save_attn", {},
+         dict(remat=True, augment=cfg.augment, remat_policy="save_attn"),
+         per_chip),
         ("no_augment", {}, dict(remat=cfg.remat, augment=False), per_chip),
         ("no_dropout", {"dropout_rate": 0.0},
          dict(remat=cfg.remat, augment=cfg.augment), per_chip),

@@ -35,6 +35,46 @@ def _setup(mesh, batch=32, seed=0):
     return model, opt, state, step, dev_batch, batch_np
 
 
+def test_remat_policies_identical_numerics(mesh8):
+    """Every named remat policy (and remat off) yields the same params
+    after a step — policies trade recompute for memory, never numerics.
+    Exercises the save_attn policy's checkpoint_name tag end-to-end."""
+    from dist_mnist_tpu.cluster.mesh import activate
+    from dist_mnist_tpu.train.step import REMAT_POLICIES
+
+    model = get_model("vit_tiny", depth=2, dim=32, heads=4, patch=8,
+                      pool="mean", dropout_rate=0.0,
+                      compute_dtype=jnp.float32)
+    opt = optim.adam(1e-3)
+    rng = np.random.default_rng(9)
+    batch_np = {
+        "image": rng.integers(0, 255, (16, 32, 32, 3), dtype=np.uint8),
+        "label": rng.integers(0, 10, (16,), dtype=np.int32),
+    }
+    results = {}
+    for name in ("off", *REMAT_POLICIES):
+        with activate(mesh8):
+            state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                       batch_np["image"][:1])
+            state = shard_train_state(state, mesh8)
+            step = make_train_step(model, opt, mesh8, donate=False,
+                                   remat=name != "off",
+                                   remat_policy=name if name != "off"
+                                   else "dots_no_batch")
+            new_state, out = step(state, shard_batch(batch_np, mesh8))
+        results[name] = (float(out["loss"]),
+                         np.asarray(new_state.params["head"]["w"]))
+    base_loss, base_w = results["off"]
+    for name, (loss, w) in results.items():
+        np.testing.assert_allclose(loss, base_loss, rtol=1e-6, err_msg=name)
+        np.testing.assert_allclose(w, base_w, rtol=1e-5, atol=1e-7,
+                                   err_msg=name)
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        from dist_mnist_tpu.train.step import resolve_remat_policy
+
+        resolve_remat_policy("bogus")
+
+
 def test_model_state_metric_contract(mesh8):
     """`_metric` entries of model_state surface as step outputs with the
     suffix stripped — the MoE routing-health channel (train/step.py)."""
