@@ -195,9 +195,17 @@ class CheckpointManager:
         3. both at once."""
         stripped, metric_keys = _strip_metric_state(target_state)
         has_blocks = _flip_block_layouts(target_state, probe_only=True)
-        # alt targets built LAZILY: the flip materializes a transient ~2x
-        # copy of params + optimizer slots on device (stack/slice ops), so
-        # it must not run unless its attempt is actually tried
+        # alt targets built LAZILY and the flip MEMOIZED: the conversion
+        # materializes a transient ~2x copy of params + optimizer slots on
+        # device (stack/slice ops), so it must run at most once, and only
+        # when a flip attempt is actually tried
+        flip_cache: list = []
+
+        def flipped():
+            if not flip_cache:
+                flip_cache.append(_flip_block_layouts(target_state))
+            return flip_cache[0]
+
         attempts = []
         if metric_keys:
             attempts.append(("without the _metric model-state entries "
@@ -205,12 +213,10 @@ class CheckpointManager:
                              lambda: stripped, False))
         if has_blocks:
             attempts.append(("in the flipped ViT block layout",
-                             lambda: _flip_block_layouts(target_state),
-                             True))
+                             flipped, True))
         if metric_keys and has_blocks:
             attempts.append(("flipped layout + no _metric entries",
-                             lambda: _strip_metric_state(
-                                 _flip_block_layouts(target_state))[0],
+                             lambda: _strip_metric_state(flipped())[0],
                              True))
         for what, make_target, is_flipped in attempts:
             try:

@@ -356,6 +356,12 @@ def _apply_flag_overrides(cfg):
     if FLAGS.prng_impl:
         over["prng_impl"] = FLAGS.prng_impl
     if FLAGS.remat_policy:
+        # validate EAGERLY: resolve_remat_policy otherwise only runs when
+        # remat=True, so a typo'd policy on a non-remat config would pass
+        # silently and the user would believe it was applied
+        from dist_mnist_tpu.train.step import resolve_remat_policy
+
+        resolve_remat_policy(FLAGS.remat_policy)
         over["remat_policy"] = FLAGS.remat_policy
     return dataclasses.replace(cfg, **over) if over else cfg
 

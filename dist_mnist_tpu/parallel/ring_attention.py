@@ -73,7 +73,13 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
     )
     acc_num, acc_den, _, _, _ = lax.fori_loop(0, n, body, init)
     out = acc_num / jnp.moveaxis(acc_den, -1, 1)[..., None]
-    return out.astype(q.dtype)
+    # save_attn remat tag (train/step.py REMAT_POLICIES): the seq-sharded
+    # path must tag its own output — it never routes through
+    # ops/nn.dot_product_attention, whose tag covers only the seq==1
+    # fallback. Identity outside jax.checkpoint.
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(out.astype(q.dtype), "attn_out")
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Measurement-day battery: run EVERYTHING that needs the real chip, in
+# dependency-free order, each stage bounded, all output accumulated to one
+# timestamped log. Designed for the flaky relay: every stage starts with
+# bench's bounded backend probe and fails fast with a structured JSON line
+# instead of hanging, so a mid-battery outage costs one stage, not the day.
+#
+#   bash scripts/measure_all.sh [outdir]
+#
+# Stages (budgets reflect docs/PERF.md: ViT cold compiles via the remote
+# compile helper need ~25 min; repeats hit /tmp/jax_compile_cache):
+#   1. bench.py headline (LeNet-5 accuracy race + throughput)
+#   2. bench.py --config for every ladder config (light first, ViT last)
+#   3. scripts/step_ablation.py  (headline step-time attribution)
+#   4. scripts/vit_probe.py      (ViT MFU attribution incl. remat_save_attn)
+#   5. scripts/perf_sweep.py     (knob table refresh)
+#   6. scripts/pp_probe.py       (pipeline schedules; needs >=8 chips —
+#                                 emits a JSON "cannot form mesh" line on 1)
+# After a full pass: update docs/PERF.md + docs/PERF_ANCHOR.json together.
+
+set -u
+OUT="${1:-/tmp/measure_all_$(date +%Y%m%d_%H%M%S)}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run_stage() { # name timeout_s cmd...
+  local name="$1" budget="$2"; shift 2
+  echo "=== [$name] start $(date -u +%H:%M:%SZ) budget=${budget}s ==="
+  ( timeout "$budget" "$@" ) >"$OUT/$name.log" 2>&1
+  local rc=$?
+  tail -3 "$OUT/$name.log"
+  echo "=== [$name] rc=$rc end $(date -u +%H:%M:%SZ) ==="
+}
+
+run_stage bench_headline 1600 python bench.py --deadline 1500
+run_stage bench_mlp       900 python bench.py --config mlp_mnist --deadline 800
+run_stage bench_lenet5    900 python bench.py --config lenet5_mnist --deadline 800
+run_stage bench_fashion   900 python bench.py --config lenet5_fashion --deadline 800
+run_stage bench_resnet   1600 python bench.py --config resnet20_cifar --deadline 1500
+# ViT family: first one pays the cold compile; siblings mostly share cache
+run_stage bench_vit      1800 python bench.py --config vit_tiny_cifar --deadline 1700
+run_stage bench_vit_tp   1800 python bench.py --config vit_tiny_cifar_tp --deadline 1700
+run_stage bench_vit_uly  1800 python bench.py --config vit_tiny_cifar_ulysses --deadline 1700
+run_stage bench_vit_ring 1800 python bench.py --config vit_tiny_cifar_ring --deadline 1700
+run_stage bench_vit_moe  1800 python bench.py --config vit_tiny_cifar_moe --deadline 1700
+run_stage bench_vit_pp   1800 python bench.py --config vit_tiny_cifar_pp --deadline 1700
+run_stage bench_vit_flash 1800 python bench.py --config vit_tiny_cifar_flash --deadline 1700
+run_stage step_ablation  1800 python scripts/step_ablation.py
+run_stage vit_probe      3600 python scripts/vit_probe.py
+run_stage perf_sweep     1800 python scripts/perf_sweep.py
+run_stage pp_probe       1800 python scripts/pp_probe.py
+
+echo "battery complete -> $OUT"
+grep -h '"metric"\|"variant"\|"summary"' "$OUT"/*.log | head -60
