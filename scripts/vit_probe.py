@@ -88,6 +88,7 @@ def main():
          per_chip),
     ]
 
+    results = {}
     with activate(mesh):
         dd = DeviceDataset(dataset, mesh)
         for name, mkw, skw, batch_per_chip in variants:
@@ -108,6 +109,7 @@ def main():
                                             batch, args.chunk, **skw)
                 dt, state, loss = timed_chunks(run, state, args.chunks)
             per_step = dt / (args.chunk * args.chunks)
+            results[name] = per_step
             # analytic, not XLA-counted (the scan-over-layers stack is
             # understated ~depth x by cost_analysis), on the PER-CHIP
             # basis bench uses: batch/chip FLOPs vs one chip's peak
@@ -126,6 +128,21 @@ def main():
                 "flops_per_step": round(fl) if fl else None,
                 "final_loss": round(loss, 4),
             }), flush=True)
+
+    # attribution summary: each knob's speedup over the ladder point (>1 =
+    # the knob costs that factor), ready to paste into docs/PERF.md
+    base = results.get("ladder_point")
+    if base:
+        print(json.dumps({
+            "attribution_speedup_vs_ladder_point": {
+                name: round(base / dt, 3)
+                for name, dt in results.items() if name != "ladder_point"
+            },
+            "note": "speedup s on a knob-off variant means the knob adds "
+                    "(s-1)/s of the LADDER step (x1.3 -> 23%); batch_2x/4x "
+                    "compare per-STEP time (throughput gain = speedup x "
+                    "batch factor)",
+        }), flush=True)
 
 
 if __name__ == "__main__":
