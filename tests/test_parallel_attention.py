@@ -222,6 +222,42 @@ def test_flash_through_vit_fwd_bwd():
                                    rtol=5e-4, atol=5e-5, err_msg=str(ka))
 
 
+def test_flash_composes_with_remat_scan():
+    """flash's custom VJP under jax.checkpoint over a lax.scan of blocks —
+    the exact composition the vit_tiny_cifar_flash ladder config compiles
+    (remat=True, scan_blocks) — at unit scale: grads must be finite and
+    match the no-remat flash path. Kept tiny: each backward recompute runs
+    the kernel under the Pallas INTERPRETER on CPU."""
+    from dist_mnist_tpu.ops.pallas import flash_attention
+
+    rng = np.random.default_rng(13)
+    b, s, h, d = 2, 16, 2, 8
+    x = jnp.asarray(rng.normal(size=(b, s, h * d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(2, h * d, h * d)) * 0.1, jnp.float32)
+
+    def block(xx, wi):
+        qkv = xx @ wi
+        q = k = v = qkv.reshape(b, s, h, d)
+        return flash_attention(q, k, v).reshape(b, s, h * d), None
+
+    def loss(w, policy):
+        def fwd(xx):
+            out, _ = jax.lax.scan(lambda c, wi: block(c, wi), xx, w)
+            return out
+
+        if policy is not None:
+            fwd = jax.checkpoint(fwd, policy=policy)
+        return jnp.sum(fwd(x) ** 2)
+
+    from dist_mnist_tpu.train.step import REMAT_POLICIES
+
+    g_plain = jax.grad(lambda w: loss(w, None))(w)
+    for name in ("dots_no_batch", "save_attn"):
+        g_remat = jax.grad(lambda w: loss(w, REMAT_POLICIES[name]))(w)
+        np.testing.assert_allclose(np.asarray(g_remat), np.asarray(g_plain),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
 def test_flash_config_selectable():
     """The flash ladder config wires the kernel end-to-end."""
     from dist_mnist_tpu.configs import get_config
