@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Chip-day helper: fold a measure_all.sh output dir into PERF_ANCHOR.json.
+
+Reads every `bench_*.log` in the given directory, takes the LAST parseable
+bench JSON line of each, and keeps only real measurements (value > 0, no
+`error` field — outage lines never become anchors). Prints the merged
+anchor document; `--write` saves it to docs/PERF_ANCHOR.json. The anchor
+file must only change together with docs/PERF.md (the regression-guard
+contract, docs/PERF.md "Regression guard") — this tool therefore prints a
+reminder diff of which metrics changed and by how much instead of touching
+PERF.md itself.
+
+Usage: python scripts/update_anchors.py /tmp/measure_r4 [--write]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+ANCHOR_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "PERF_ANCHOR.json")
+
+
+def harvest(outdir: str) -> dict:
+    """metric -> {value, device_kind} from the last good line per log."""
+    found = {}
+    for name in sorted(os.listdir(outdir)):
+        if not (name.startswith("bench_") and name.endswith(".log")):
+            continue
+        best = None
+        with open(os.path.join(outdir, name)) as fh:
+            for line in fh:
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and "metric" in obj:
+                    best = obj
+        if not best:
+            continue
+        if best.get("error") or not best.get("value"):
+            print(f"# {name}: outage/zero line — NOT an anchor "
+                  f"({str(best.get('error'))[:80]})", file=sys.stderr)
+            continue
+        kind = (best.get("extra") or {}).get("device_kind")
+        if not kind:
+            print(f"# {name}: no device_kind — skipped", file=sys.stderr)
+            continue
+        metric = best["metric"]
+        if metric in found:
+            # bench_headline.log and bench_lenet5.log BOTH emit the
+            # headline metric (bench.py with/without --config); the
+            # headline run is the metric of record and sorts first —
+            # keep the first, loudly
+            print(f"# {name}: duplicate {metric} — keeping the earlier "
+                  "log's value (headline run is the metric of record)",
+                  file=sys.stderr)
+            continue
+        found[metric] = {"value": best["value"], "device_kind": kind}
+    return found
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("outdir")
+    ap.add_argument("--write", action="store_true")
+    ap.add_argument("--allow-kind-change", action="store_true",
+                    help="permit replacing an anchor with one measured on "
+                         "DIFFERENT hardware (default: refuse — a CPU "
+                         "smoke run must never overwrite TPU anchors)")
+    args = ap.parse_args()
+
+    new = harvest(args.outdir)
+    if not new:
+        print("no usable bench lines found — nothing to do", file=sys.stderr)
+        return 1
+
+    with open(ANCHOR_PATH) as fh:
+        doc = json.load(fh)
+    for metric, entry in new.items():
+        old_entry = doc.get(metric, {})
+        old = old_entry.get("value")
+        old_kind = old_entry.get("device_kind")
+        if old_kind and old_kind != entry["device_kind"] \
+                and not args.allow_kind_change:
+            # the same cross-hardware guard bench._anchor_fields applies:
+            # a ratio across device kinds is meaningless, and a CPU smoke
+            # must not destroy the committed TPU regression baseline
+            print(f"# {metric}: measured on {entry['device_kind']!r} but "
+                  f"anchor is {old_kind!r} — REFUSED (pass "
+                  "--allow-kind-change for a real hardware migration)",
+                  file=sys.stderr)
+            continue
+        delta = (f" ({(entry['value'] - old) / old:+.1%} vs {old})"
+                 if old and old_kind == entry["device_kind"] else " (new)")
+        print(f"# {metric}: {entry['value']}{delta}", file=sys.stderr)
+        doc[metric] = entry
+    doc["_measured"] = (
+        f"{datetime.date.today().isoformat()}, device_get stop-clock, "
+        f"measure_all battery ({os.path.basename(args.outdir)})"
+    )
+    out = json.dumps(doc, indent=2)
+    print(out)
+    if args.write:
+        with open(ANCHOR_PATH, "w") as fh:
+            fh.write(out + "\n")
+        print(f"# wrote {ANCHOR_PATH} — now update docs/PERF.md's tables "
+              "in the same commit", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
