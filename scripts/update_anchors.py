@@ -81,24 +81,36 @@ def main() -> int:
 
     with open(ANCHOR_PATH) as fh:
         doc = json.load(fh)
+    # the document's prevailing hardware: NEW metrics must match it too —
+    # a CPU smoke must not seed CPU anchors that later block real TPU runs
+    kinds = [v.get("device_kind") for k, v in doc.items()
+             if isinstance(v, dict) and v.get("device_kind")]
+    prevailing = max(set(kinds), key=kinds.count) if kinds else None
+    accepted = 0
     for metric, entry in new.items():
         old_entry = doc.get(metric, {})
         old = old_entry.get("value")
-        old_kind = old_entry.get("device_kind")
-        if old_kind and old_kind != entry["device_kind"] \
+        expect_kind = old_entry.get("device_kind") or prevailing
+        if expect_kind and expect_kind != entry["device_kind"] \
                 and not args.allow_kind_change:
             # the same cross-hardware guard bench._anchor_fields applies:
             # a ratio across device kinds is meaningless, and a CPU smoke
-            # must not destroy the committed TPU regression baseline
+            # must not pollute the committed TPU regression baseline
             print(f"# {metric}: measured on {entry['device_kind']!r} but "
-                  f"anchor is {old_kind!r} — REFUSED (pass "
+                  f"the anchor baseline is {expect_kind!r} — REFUSED (pass "
                   "--allow-kind-change for a real hardware migration)",
                   file=sys.stderr)
             continue
         delta = (f" ({(entry['value'] - old) / old:+.1%} vs {old})"
-                 if old and old_kind == entry["device_kind"] else " (new)")
+                 if old and old_entry.get("device_kind") == entry["device_kind"]
+                 else " (new)")
         print(f"# {metric}: {entry['value']}{delta}", file=sys.stderr)
         doc[metric] = entry
+        accepted += 1
+    if not accepted:
+        print("# no metric accepted — anchors unchanged, nothing written",
+              file=sys.stderr)
+        return 1
     doc["_measured"] = (
         f"{datetime.date.today().isoformat()}, device_get stop-clock, "
         f"measure_all battery ({os.path.basename(args.outdir)})"

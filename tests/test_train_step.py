@@ -75,6 +75,39 @@ def test_remat_policies_identical_numerics(mesh8):
         resolve_remat_policy("bogus")
 
 
+def test_save_attn_policy_saves_attention_residual():
+    """Under save_attn the checkpoint_name("attn_out")-tagged attention
+    output is an actually-SAVED residual (jax.ad_checkpoint.saved_residuals
+    — the ground truth for what remat keeps), and under dots_no_batch it is
+    not: the policy difference is real, not just named."""
+    # not re-exported from jax.ad_checkpoint in this jax version (only
+    # print_saved_residuals is); pinned-env test, private import ok
+    from jax._src.ad_checkpoint import saved_residuals
+
+    from dist_mnist_tpu.models import get_model as gm
+    from dist_mnist_tpu.ops.losses import softmax_cross_entropy
+    from dist_mnist_tpu.train.step import REMAT_POLICIES
+
+    model = gm("vit_tiny", depth=1, dim=64, heads=4, patch=8, pool="mean",
+               dropout_rate=0.0, compute_dtype=jnp.float32,
+               scan_blocks=False)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (2,)), jnp.int32)
+    params, state = model.init(jax.random.PRNGKey(0), x)
+
+    def fwd(p):
+        logits, _ = model.apply(p, state, x, train=False)
+        return softmax_cross_entropy(logits, y)
+
+    def saved_from_attention(policy):
+        res = saved_residuals(jax.checkpoint(fwd, policy=policy), params)
+        return any("dot_product_attention" in str(src) for _, src in res)
+
+    assert saved_from_attention(REMAT_POLICIES["save_attn"])
+    assert not saved_from_attention(REMAT_POLICIES["dots_no_batch"])
+
+
 def test_model_state_metric_contract(mesh8):
     """`_metric` entries of model_state surface as step outputs with the
     suffix stripped — the MoE routing-health channel (train/step.py)."""
