@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Bounded TPU-relay liveness probe with an append-only evidence log.
+
+The axon relay backend in this image goes down for days at a time
+(docs/OUTAGES.md); bench/measure-day tooling needs a cheap, *bounded*
+"is the chip reachable right now?" check whose result is recorded in-repo
+so each round's verdict can audit when measurement was actually possible.
+
+    python scripts/probe_tpu.py [--retries 3] [--timeout 150] [--log ...]
+
+Probe semantics are deliberately STRICTER than bench.py's `_probe`
+(which only lists devices): this one also executes a tiny program and
+`device_get`s the result, because on this relay a value transfer cannot
+complete early (docs/PERF.md "Timing methodology"). The retry/timeout
+constants DO match bench's (3 × 150 s) so an OUTAGES.md row and a
+BENCH_rNN.json `probe_errors` entry from the same window agree about
+whether measurement was possible. Unlike bench's probe there is no
+JAX_PLATFORMS=cpu override path — liveness of the site-default (axon
+TPU) platform is exactly the question. Appends one markdown table row
+per invocation (not per retry) and prints one JSON line. Exit 0 = alive.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+_PROBE_SRC = (
+    "import jax, time; t0=time.time(); d=jax.devices();"
+    "import jax.numpy as jnp;"
+    "x=jnp.ones((128,128)); v=float(jax.device_get(jnp.dot(x,x)).sum());"
+    "print('PROBE_OK', d[0].platform, len(d), round(time.time()-t0,1), v)"
+)
+
+
+def probe(retries: int, timeout_s: int) -> dict:
+    t0 = time.time()
+    ok, detail = False, ""
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s,
+                env=os.environ.copy(),
+            )
+            ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+            lines = (r.stdout + r.stderr).strip().splitlines()
+            detail = lines[-1] if lines else ""
+        except subprocess.TimeoutExpired:
+            ok, detail = False, f"probe timed out after {timeout_s}s"
+        if ok:
+            break
+        detail = f"attempt {attempt + 1}/{retries}: {detail}"
+    return {
+        "ts": datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"),
+        "ok": ok,
+        "elapsed_s": round(time.time() - t0, 1),
+        "detail": detail[-200:],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--retries", type=int, default=3)
+    ap.add_argument("--timeout", type=int, default=150)
+    ap.add_argument("--log", default=str(REPO / "docs" / "OUTAGES.md"))
+    ap.add_argument("--no-log", action="store_true")
+    args = ap.parse_args()
+
+    res = probe(args.retries, args.timeout)
+    print(json.dumps(res))
+    if not args.no_log:
+        log = pathlib.Path(args.log)
+        if not log.exists():
+            log.write_text(
+                "# TPU relay probe log\n\n"
+                "Append-only record of bounded liveness probes "
+                "(`scripts/probe_tpu.py`). Each row is one out-of-process\n"
+                "probe: import jax, run one tiny program, device_get the "
+                "result, bounded by the stated timeout.\n\n"
+                "| UTC time | alive | elapsed | detail |\n"
+                "|---|---|---|---|\n")
+        detail = res["detail"].replace("|", "\\|")
+        with log.open("a") as f:
+            f.write(f"| {res['ts']} | {'YES' if res['ok'] else 'no'} "
+                    f"| {res['elapsed_s']}s | {detail} |\n")
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
