@@ -205,6 +205,28 @@ CONFIGS = {
         mesh=MeshSpec(data=-1, seq=2),
         ladder_devices=16,
     ),
+    # 5f') config 5f with the Pallas kernel as the ring's LOCAL block
+    # engine (flash_attention_lse's merge-ready (out, lse) pair feeding the
+    # blockwise-LSE accumulator): the composed long-context configuration —
+    # O(S_local) HBM from the ring AND VMEM score tiles from the kernel.
+    "vit_tiny_cifar_ring_flash": Config(
+        name="vit_tiny_cifar_ring_flash",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"attention_impl": "ring_flash", "pool": "mean",
+                      "scan_blocks": True},
+        mesh=MeshSpec(data=-1, seq=2),
+        ladder_devices=16,
+    ),
     # 5g) config 5 with the Pallas flash-attention kernel (fused VMEM
     # softmax-attention, fwd + custom-VJP bwd — ops/pallas/flash_attention):
     # the single-chip kernel leg of SURVEY §5.7's blockwise-attention row

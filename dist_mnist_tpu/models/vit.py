@@ -59,7 +59,8 @@ class ViTTiny:
     mlp_ratio: int = 4
     dropout_rate: float = 0.1
     compute_dtype: jnp.dtype = jnp.bfloat16
-    attention_impl: str = "xla"  # "xla" | "flash" | "ring" | "ulysses"
+    # "xla" | "flash" | "ring" | "ring_flash" | "ulysses"
+    attention_impl: str = "xla"
     pool: str = "cls"  # "cls" | "mean" (mean keeps token count a power of
     # two — required when the sequence dim is sharded, e.g. ring attention)
     mlp_impl: str = "dense"  # "dense" | "moe" (switch-routed expert FFN,
@@ -181,10 +182,16 @@ class ViTTiny:
             from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
 
             out = flash_attention(q, k, v)
-        elif self.attention_impl == "ring":
+        elif self.attention_impl in ("ring", "ring_flash"):
             from dist_mnist_tpu.parallel.ring_attention import ring_attention
 
-            out = ring_attention(q, k, v)
+            # ring_flash = sequence-sharded ring whose LOCAL block runs the
+            # Pallas kernel (VMEM score tiles) instead of an HBM einsum —
+            # the long-context composition (flash_attention.py docstring)
+            out = ring_attention(
+                q, k, v,
+                impl="flash" if self.attention_impl == "ring_flash"
+                else "xla")
         elif self.attention_impl == "ulysses":
             from dist_mnist_tpu.parallel.ulysses import ulysses_attention
 
@@ -192,7 +199,7 @@ class ViTTiny:
         else:
             raise ValueError(
                 f"unknown attention_impl {self.attention_impl!r}; "
-                "use 'xla' | 'flash' | 'ring' | 'ulysses'"
+                "use 'xla' | 'flash' | 'ring' | 'ring_flash' | 'ulysses'"
             )
         if self.attention_impl == "flash":
             # same save_attn remat tag the other impls get inside

@@ -7,7 +7,10 @@ CPU test mesh exercises the same code, and a pure-XLA reference
 implementation it is tested against.
 """
 
-from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
+from dist_mnist_tpu.ops.pallas.flash_attention import (
+    flash_attention,
+    flash_attention_lse,
+)
 from dist_mnist_tpu.ops.pallas.fused_adam import fused_adam_update
 
-__all__ = ["flash_attention", "fused_adam_update"]
+__all__ = ["flash_attention", "flash_attention_lse", "fused_adam_update"]

@@ -10,7 +10,11 @@ Layout: grid = (B*H, S/block_q); per program: q tile [block_q, D], full K/V
 [S, D] for that (batch, head). VMEM budget at default block_q=128, S<=8192,
 D<=128, bf16: ~2 MB score tile + ~4 MB K/V — inside the ~16 MB/core VMEM.
 For longer S, shard the sequence first (parallel/ring_attention.py) and let
-each device run this kernel on its local block.
+each device run this kernel on its local block: `flash_attention_lse`
+returns the merge-ready `(out, lse)` pair and `ring_attention_inner`
+(`impl="flash"`) consumes it as a blockwise-LSE contribution `(num=out,
+den=1, m=lse)` — that composition is tested, not prose
+(tests/test_parallel_attention.py::test_ring_flash_*).
 
 Training: `flash_attention` carries a `jax.custom_vjp`. The forward kernel
 additionally emits the per-row log-sum-exp (LSE); the backward recomputes
@@ -171,7 +175,12 @@ def _flash_fwd_impl(q, k, v, block_q: int, interpret: bool):
 
 
 @functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
-def _flash_bwd_impl(q, k, v, out, lse, do, block_q: int, interpret: bool):
+def _flash_bwd_impl(q, k, v, out, lse, do, dlse, block_q: int,
+                    interpret: bool):
+    """dlse is the [B,H,S] f32 cotangent of the returned LSE (zeros for the
+    out-only entry point). It needs no kernel change: dlogits =
+    p*(dp - delta + dlse) row-wise, so it folds into the delta argument as
+    `delta - dlse`; dV is p^T @ dO, independent of lse."""
     b, s, h, d = q.shape
     scale = d**-0.5
     s_pad = _round_up(s, 128)
@@ -183,8 +192,12 @@ def _flash_bwd_impl(q, k, v, out, lse, do, block_q: int, interpret: bool):
     ob = _to_bh(out, b, h, s, d, q_pad)
     dob = _to_bh(do, b, h, s, d, q_pad)
     # delta_i = sum_d dO_id * O_id — one cheap fused elementwise pass in XLA;
-    # zero on padded rows because dO and O are zero-padded.
+    # zero on padded rows because dO and O are zero-padded (and so is the
+    # padded tail of the dlse fold-in below).
     delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1)
+    delta = delta - jnp.pad(
+        dlse.astype(jnp.float32).reshape(b * h, s),
+        ((0, 0), (0, q_pad - s)))
 
     vec_spec_q = pl.BlockSpec((1, block_q), lambda i, j: (i, j),
                               memory_space=pltpu.VMEM)
@@ -240,22 +253,72 @@ def _flash_attention_fwd(q, k, v, block_q: int, interpret: bool):
 
 def _flash_attention_bwd(block_q: int, interpret: bool, res, do):
     q, k, v, out, lse = res
-    return _flash_bwd_impl(q, k, v, out, lse, do, block_q, interpret)
+    zero_dlse = jnp.zeros((q.shape[0], q.shape[2], q.shape[1]), jnp.float32)
+    return _flash_bwd_impl(q, k, v, out, lse, do, zero_dlse, block_q,
+                           interpret)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_lse(q, k, v, block_q: int, interpret: bool):
+    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret)
+    b, s, h, _ = q.shape
+    return out, lse[:, :s].reshape(b, h, s)
+
+
+def _flash_attention_lse_fwd(q, k, v, block_q: int, interpret: bool):
+    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret)
+    b, s, h, _ = q.shape
+    return (out, lse[:, :s].reshape(b, h, s)), (q, k, v, out, lse)
+
+
+def _flash_attention_lse_bwd(block_q: int, interpret: bool, res, cts):
+    q, k, v, out, lse = res
+    do, dlse = cts
+    return _flash_bwd_impl(q, k, v, out, lse, do, dlse, block_q, interpret)
+
+
+_flash_attention_lse.defvjp(_flash_attention_lse_fwd,
+                            _flash_attention_lse_bwd)
+
+
+def _quantize_block_q(block_q: int, s: int) -> int:
+    # 128-align the q tile in BOTH directions (round a small/odd block_q
+    # UP, cap at the padded sequence): the LSE rides the lane axis in the
+    # backward kernels and TPU lanes want multiples of 128. Padded rows
+    # are zero-filled and self-cancelling.
+    return min(_round_up(block_q, 128), _round_up(s, 128))
 
 
 def flash_attention(q, k, v, *, block_q: int = 128,
                     interpret: bool | None = None):
     """[B,S,H,D] self-attention, fused in VMEM. Drop-in for
     ops/nn.dot_product_attention (non-causal), forward and backward —
-    differentiable via a recompute-based custom VJP."""
+    differentiable via a recompute-based custom VJP.
+
+    `block_q` is quantized to 128-lane multiples (rounded UP, capped at the
+    padded sequence length): requesting e.g. block_q=8 runs with 128, so it
+    cannot be tuned *below* 128 for VMEM headroom — shrink S per device
+    (sequence-shard, see flash_attention_lse) instead."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # 128-align the q tile in BOTH directions (round a small/odd block_q
-    # UP, cap at the padded sequence): the LSE rides the lane axis in the
-    # backward kernels and TPU lanes want multiples of 128. Padded rows
-    # are zero-filled and self-cancelling.
-    block_q = min(_round_up(block_q, 128), _round_up(q.shape[1], 128))
-    return _flash_attention(q, k, v, block_q, interpret)
+    return _flash_attention(q, k, v, _quantize_block_q(block_q, q.shape[1]),
+                            interpret)
+
+
+def flash_attention_lse(q, k, v, *, block_q: int = 128,
+                        interpret: bool | None = None):
+    """Like `flash_attention` but returns `(out [B,S,H,D], lse [B,H,S])` —
+    the merge-ready pair for blockwise/ring composition: a caller holding
+    per-block `(out_b, lse_b)` recovers the exact global softmax via the
+    LSE identity (treat each block as numerator `out_b`, denominator 1,
+    running max `lse_b`). Differentiable in BOTH outputs: the lse cotangent
+    folds into the same backward kernels as `delta - dlse` (see
+    _flash_bwd_impl), which is what makes ring(flash-local) train-grade.
+    Same block_q quantization as `flash_attention`."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_attention_lse(
+        q, k, v, _quantize_block_q(block_q, q.shape[1]), interpret)

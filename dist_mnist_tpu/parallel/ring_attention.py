@@ -8,13 +8,16 @@ via `ppermute`, and softmax is accumulated blockwise in log-sum-exp form
 the full S x S score matrix — attention memory is O(S_local^2 * ring) time,
 O(S_local) memory per device.
 
-Two entry points:
-- `ring_attention_inner(q, k, v, axis_name)` — call INSIDE shard_map.
-- `ring_self_attention(q, k, v, mesh)` — wraps shard_map over `mesh`'s
-  `seq` axis (composes under jit).
-- `ring_attention(q, k, v)` — convenience used by models: rings over the
-  ambient mesh when it has a seq axis > 1, else falls back to plain
-  attention (so the same model code runs on any mesh).
+Entry points (each takes `impl="xla"|"flash"` to pick the local-block
+engine — "flash" runs the Pallas kernel per block so score tiles stay in
+VMEM even while the ring keeps HBM at O(S_local); see
+ring_attention_inner):
+- `ring_attention_inner(q, k, v, axis_name, impl)` — call INSIDE shard_map.
+- `ring_self_attention(q, k, v, mesh, impl=...)` — wraps shard_map over
+  `mesh`'s `seq` axis (composes under jit).
+- `ring_attention(q, k, v, impl=...)` — convenience used by models: rings
+  over the ambient mesh when it has a seq axis > 1, else falls back to the
+  impl-matched dense path (so the same model code runs on any mesh).
 
 Non-causal (bidirectional) attention, matching ops/nn.dot_product_attention;
 inputs [B, S(, _local), H, D].
@@ -33,13 +36,36 @@ from dist_mnist_tpu.cluster.mesh import SEQ_AXIS
 from dist_mnist_tpu.parallel.collectives import ring_shift
 
 
-def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
-    """Blockwise-LSE ring attention; q/k/v are this device's [B,Sl,H,D]."""
+def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
+                         impl: str = "xla"):
+    """Blockwise-LSE ring attention; q/k/v are this device's [B,Sl,H,D].
+
+    `impl` selects how each device computes its LOCAL q x k_block piece:
+    - "xla": einsum — materializes the [B,H,Sl,Sl] score tile in HBM.
+    - "flash": the Pallas kernel (ops/pallas/flash_attention_lse) — the
+      score tile stays in VMEM, and the kernel's (out, lse) pair IS a
+      merge-ready blockwise contribution: out is the block-normalized
+      numerator, so (num=out, den=1, m=lse) drops into the same LSE
+      accumulator (out * exp(lse - new_max) = exp(logits - new_max) @ V
+      and 1 * exp(lse - new_max) = rowsum exp(logits - new_max)). This is
+      the long-S configuration SP exists for: O(S_local) HBM from the ring
+      AND VMEM-resident score tiles from the kernel.
+    The merge itself is f32 in both paths, and at f32 inputs they agree to
+    rounding. They differ ONLY in local-block precision: "xla" upcasts the
+    whole block to f32 (HBM-expensive — part of why it needs the score
+    tile); "flash" keeps the kernel's input-dtype output, so bf16 runs
+    round each block's numerator to bf16 before the f32 merge (~1e-2
+    relative — the standard flash-kernel contract; forcing f32 through the
+    kernel would forfeit the MXU bf16 path it exists for). Both paths are
+    differentiable — flash's lse cotangent is handled by its custom VJP."""
+    if impl not in ("xla", "flash"):
+        raise ValueError(
+            f"ring attention impl {impl!r}: use 'xla' | 'flash'")
     n = lax.axis_size(axis_name)
     scale = q.shape[-1] ** -0.5
     qf = q.astype(jnp.float32)
 
-    def block(qf, k_blk, v_blk):
+    def block_xla(k_blk, v_blk):
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
         logits *= scale
         m = jnp.max(logits, axis=-1)  # [B,H,Sq]
@@ -48,9 +74,19 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
         den = jnp.sum(p, axis=-1)  # [B,H,Sq]
         return num, den, m
 
+    def block_flash(k_blk, v_blk):
+        from dist_mnist_tpu.ops.pallas.flash_attention import (
+            flash_attention_lse,
+        )
+
+        out, lse = flash_attention_lse(q, k_blk, v_blk)  # [B,Sq,H,D],[B,H,Sq]
+        return out.astype(jnp.float32), jnp.ones_like(lse), lse
+
+    block = block_flash if impl == "flash" else block_xla
+
     def body(i, carry):
         acc_num, acc_den, acc_max, k_blk, v_blk = carry
-        num, den, m = block(qf, k_blk, v_blk)
+        num, den, m = block(k_blk, v_blk)
         new_max = jnp.maximum(acc_max, m)
         old_scale = jnp.exp(acc_max - new_max)
         blk_scale = jnp.exp(m - new_max)
@@ -82,16 +118,18 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
     return checkpoint_name(out.astype(q.dtype), "attn_out")
 
 
-def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
+def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
+                        impl: str = "xla"):
     """shard_map wrapper over [B,S,H,D]: batch stays sharded over `data`,
     heads over `model`, and the sequence dim rings over `axis_name` — the
     full hybrid DP x TP x SP layout in one spec. Requires B % data == 0,
-    H % model == 0, S % seq == 0."""
+    H % model == 0, S % seq == 0. `impl` picks the local-block engine
+    (see ring_attention_inner)."""
     from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
 
     spec = P(DATA_AXIS, axis_name, MODEL_AXIS, None)
     fn = jax.shard_map(
-        partial(ring_attention_inner, axis_name=axis_name),
+        partial(ring_attention_inner, axis_name=axis_name, impl=impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -100,12 +138,25 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
     return fn(q, k, v)
 
 
-def ring_attention(q, k, v):
+def ring_attention(q, k, v, impl: str = "xla"):
     """Mesh-adaptive entry used by models: ring over the ambient mesh's
-    `seq` axis when present (>1), else exact fallback."""
+    `seq` axis when present (>1), else exact fallback (flash kernel when
+    impl="flash", plain attention otherwise — so the same model code runs
+    on any mesh AND keeps its kernel choice when the mesh has no seq
+    axis)."""
     mesh = get_abstract_mesh()
     if mesh is None or SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] == 1:
+        if impl == "flash":
+            from dist_mnist_tpu.ops.pallas.flash_attention import (
+                flash_attention,
+            )
+            from jax.ad_checkpoint import checkpoint_name
+
+            # same attn_out tag ring_attention_inner applies on the
+            # sharded path (and dot_product_attention applies on the
+            # dense fallback) — keeps save_attn remat policy uniform
+            return checkpoint_name(flash_attention(q, k, v), "attn_out")
         from dist_mnist_tpu.ops.nn import dot_product_attention
 
         return dot_product_attention(q, k, v)
-    return ring_self_attention(q, k, v, mesh)
+    return ring_self_attention(q, k, v, mesh, impl=impl)

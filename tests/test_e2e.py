@@ -16,6 +16,7 @@ def test_config_registry_covers_ladder():
         "resnet20_cifar", "vit_tiny_cifar", "vit_tiny_cifar_ulysses",
         "vit_tiny_cifar_moe", "vit_tiny_cifar_pp", "vit_tiny_cifar_tp",
         "vit_tiny_cifar_ring", "vit_tiny_cifar_flash",
+        "vit_tiny_cifar_ring_flash",
     }
     # every §2.6 strategy is CLI-selectable from the ladder: DP (all),
     # TP, SP-ring, SP-ulysses, EP-moe, PP — one config each
@@ -150,14 +151,18 @@ def test_tensor_parallel_config_e2e(tmp_path):
      {"dim": 32, "depth": 2, "heads": 4, "patch": 8}),
     ("vit_tiny_cifar_pp", MeshSpec(data=2, pipe=4),
      {"dim": 32, "depth": 4, "heads": 4, "patch": 8}),  # depth % pipe == 0
-    # vit_tiny_cifar_flash is deliberately NOT here: the Pallas INTERPRETER
-    # (CPU) makes even the un-remat'd flash backward pathologically slow at
-    # driver scale (measured >50 CPU-min at dim 32/batch 16). Flash is
-    # covered at unit scale instead: grads-vs-reference, through-ViT
-    # fwd/bwd, the flash+remat+scan composition
+    # vit_tiny_cifar_flash / _ring_flash are deliberately NOT here: the
+    # Pallas INTERPRETER (CPU) makes even the un-remat'd flash backward
+    # pathologically slow at driver scale (measured >50 CPU-min at dim
+    # 32/batch 16). Flash is covered at unit scale instead:
+    # grads-vs-reference, through-ViT fwd/bwd, the flash+remat+scan
+    # composition
     # (test_parallel_attention.py::test_flash_composes_with_remat_scan),
-    # and config plumbing (::test_flash_config_selectable); the driver path
-    # differs from vit_tiny_cifar only by `attention_impl`.
+    # the ring composition (::test_ring_flash_matches_dense,
+    # ::test_ring_flash_through_vit_fwd_bwd), and config plumbing
+    # (::test_flash_config_selectable, ::test_ring_flash_config_selectable);
+    # the driver paths differ from vit_tiny_cifar(_ring) only by
+    # `attention_impl`.
 ])
 def test_strategy_ladder_configs_through_driver(tmp_path, name, mesh,
                                                 small_kwargs):
