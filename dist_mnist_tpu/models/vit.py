@@ -179,9 +179,11 @@ class ViTTiny:
         qkv = nn.dense(p["qkv"], x).reshape(b, s, 3, h, d // h)
         q, k, v = jnp.moveaxis(qkv, 2, 0)
         if self.attention_impl == "flash":
-            from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
+            # mesh-adaptive: per-device local heads under a model axis
+            # (a bare pallas_call would replicate — parallel/flash.py)
+            from dist_mnist_tpu.parallel.flash import flash_attention_sharded
 
-            out = flash_attention(q, k, v)
+            out = flash_attention_sharded(q, k, v)
         elif self.attention_impl in ("ring", "ring_flash"):
             from dist_mnist_tpu.parallel.ring_attention import ring_attention
 

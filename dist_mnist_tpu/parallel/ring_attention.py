@@ -147,15 +147,18 @@ def ring_attention(q, k, v, impl: str = "xla"):
     mesh = get_abstract_mesh()
     if mesh is None or SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] == 1:
         if impl == "flash":
-            from dist_mnist_tpu.ops.pallas.flash_attention import (
-                flash_attention,
-            )
             from jax.ad_checkpoint import checkpoint_name
+
+            from dist_mnist_tpu.parallel.flash import flash_attention_sharded
 
             # same attn_out tag ring_attention_inner applies on the
             # sharded path (and dot_product_attention applies on the
-            # dense fallback) — keeps save_attn remat policy uniform
-            return checkpoint_name(flash_attention(q, k, v), "attn_out")
+            # dense fallback) — keeps save_attn remat policy uniform.
+            # flash_attention_sharded, not the bare kernel: a seq-less
+            # mesh can still carry a model axis (ring_flash under TP),
+            # and the bare pallas_call would silently replicate there.
+            return checkpoint_name(flash_attention_sharded(q, k, v),
+                                   "attn_out")
         from dist_mnist_tpu.ops.nn import dot_product_attention
 
         return dot_product_attention(q, k, v)

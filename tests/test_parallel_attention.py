@@ -582,3 +582,76 @@ def test_explicit_dp_step_matches_gspmd_with_aux(mesh8):
                                rtol=2e-5)
     np.testing.assert_allclose(results["gspmd"][1], results["explicit"][1],
                                rtol=2e-4, atol=2e-6)
+
+
+def _vit_flash(heads):
+    """Tiny float32 ViT on the flash path (exactness vs unsharded)."""
+    from dist_mnist_tpu.models.vit import ViTTiny
+
+    return ViTTiny(depth=2, dim=48, heads=heads, dropout_rate=0.0,
+                   compute_dtype=jnp.float32, attention_impl="flash",
+                   scan_blocks=True)
+
+
+def test_flash_tp_matches_unsharded(mesh_tp):
+    """flash x TP composition (VERDICT r4 weak #3): a bare pallas_call
+    cannot be GSPMD-partitioned, so under a model axis the model runs the
+    kernel per-device over LOCAL heads via shard_map (Megatron TP
+    attention). Logits and param grads must match the unsharded kernel."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dist_mnist_tpu.cluster.mesh import DATA_AXIS, activate
+    from dist_mnist_tpu.parallel.sharding import TP_RULES, tree_sharding
+
+    model = _vit_flash(heads=2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+    params, _ = model.init(jax.random.PRNGKey(0), x)
+
+    def loss(p, xx):
+        logits, _ = model.apply(p, {}, xx)
+        return jnp.sum(logits ** 2)
+
+    expected = loss(params, x)
+    g_expected = jax.grad(loss)(params, x)
+    with activate(mesh_tp):
+        p_sh = jax.device_put(params, tree_sharding(params, mesh_tp,
+                                                    TP_RULES))
+        x_sh = jax.device_put(x, NamedSharding(mesh_tp, P(DATA_AXIS)))
+        got = jax.jit(loss)(p_sh, x_sh)
+        g_got = jax.jit(jax.grad(loss))(p_sh, x_sh)
+    np.testing.assert_allclose(float(got), float(expected), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+        g_expected, g_got,
+    )
+
+
+def test_flash_tp_indivisible_heads_raises(mesh_tp):
+    """heads % model != 0 must refuse at trace time with a clear error,
+    not die deep inside XLA partitioning (the same loud-refusal standard
+    shard_train_state applies to no-match rules)."""
+    from dist_mnist_tpu.cluster.mesh import activate
+
+    model = _vit_flash(heads=3)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+    params, _ = model.init(jax.random.PRNGKey(0), x)
+    with activate(mesh_tp):
+        with pytest.raises(ValueError, match="heads"):
+            jax.jit(lambda p, xx: model.apply(p, {}, xx)[0])(params, x)
+
+
+def test_ring_flash_fallback_tp_mesh_local_heads(mesh_tp):
+    """ring_flash's seq-absent fallback on a mesh that still carries a
+    model axis must route through flash_attention_sharded (local heads),
+    not the bare kernel — the same silent-replication hazard as flash+TP,
+    one dispatch layer down (code-review r5)."""
+    from dist_mnist_tpu.cluster.mesh import activate
+
+    q, k, v = _qkv(h=4, seed=7)
+    expected = dot_product_attention(q, k, v)
+    with activate(mesh_tp):
+        out = jax.jit(lambda a, b, c: ring_attention(a, b, c,
+                                                     impl="flash"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
