@@ -250,7 +250,8 @@ CONFIGS = {
     ),
     # 5d) config 5 with the block stack GPipe'd over a 4-stage `pipe` axis
     # (3 blocks per stage, microbatched activations around the ICI ring —
-    # parallel/pipeline.py). Dropout-free: stage fns carry no rng.
+    # parallel/pipeline.py). Trains with the default dropout 0.1 like its
+    # siblings: the schedule threads a per-(shard, microbatch, stage) key.
     "vit_tiny_cifar_pp": Config(
         name="vit_tiny_cifar_pp",
         model="vit_tiny",
@@ -264,8 +265,7 @@ CONFIGS = {
         weight_decay=0.05,
         remat=True,
         augment=True,
-        model_kwargs={"scan_blocks": True, "block_pipeline": 4,
-                      "dropout_rate": 0.0},
+        model_kwargs={"scan_blocks": True, "block_pipeline": 4},
         mesh=MeshSpec(data=-1, pipe=4),
         ladder_devices=16,
     ),

@@ -78,8 +78,8 @@ class ViTTiny:
     # param paths (block0/...) stay addressable by older sharding rules.
     block_pipeline: int = 0  # N>0: shard the block stack into N GPipe
     # stages over the `pipe` mesh axis (parallel/pipeline.py). Needs
-    # scan_blocks (stacked layout), depth % N == 0, dropout_rate == 0
-    # (stage fns carry no rng), dense MLP. Engages only when the ambient
+    # scan_blocks (stacked layout), depth % N == 0, dense MLP; dropout is
+    # fine (per-(microbatch, stage) keys). Engages only when the ambient
     # mesh's pipe axis equals N; on any other mesh the same model falls
     # back to the plain scan — one model, any topology.
     pipeline_microbatches: int = 8  # GPipe M; bubble = (N-1)/(M+N-1)
@@ -266,10 +266,17 @@ class ViTTiny:
             )
         return False
 
-    def _pipelined_blocks(self, params, x, use_dropout):
+    def _pipelined_blocks(self, params, x, use_dropout, rng=None):
         """GPipe the block stack over the `pipe` mesh axis: stage s runs
         blocks [s*depth/N, (s+1)*depth/N) as an inner scan; activations
-        flow stage->stage via ppermute (parallel/pipeline.py)."""
+        flow stage->stage via ppermute (parallel/pipeline.py).
+
+        Dropout: the schedule derives a key per (data shard, microbatch,
+        global stage) (pipeline_apply's rng threading), and each block
+        folds its local index in — masks are i.i.d. per (shard,
+        microbatch, layer), so training is statistically equivalent to
+        the scanned path's per-layer keys (the exact mask STREAM differs:
+        the scanned path draws one full-batch mask per layer)."""
         from jax.sharding import get_abstract_mesh
 
         from dist_mnist_tpu.cluster.mesh import PIPE_AXIS
@@ -283,11 +290,6 @@ class ViTTiny:
                 "block_pipeline needs scan_blocks=True and depth % "
                 "(stages * circular_chunks) == 0"
             )
-        if use_dropout:
-            raise ValueError(
-                "the pipeline path runs dropout-free (stage fns carry no "
-                "rng); set dropout_rate=0"
-            )
         if self.mlp_impl == "moe":
             raise ValueError("block_pipeline supports dense MLP blocks only")
         per_stage = self.depth // (n * v)
@@ -296,13 +298,25 @@ class ViTTiny:
             params["blocks"],
         )
 
-        def stage_fn(p, xx):
-            def body(carry, pp):
-                out, _, _ = self._block(pp, carry, None, False)
-                return out, None
+        if use_dropout:
+            def stage_fn(p, xx, key):
+                def body(carry, xs):
+                    pp, i = xs
+                    out, _, _ = self._block(
+                        pp, carry, jax.random.fold_in(key, i), True)
+                    return out, None
 
-            out, _ = jax.lax.scan(body, xx, p)
-            return out
+                out, _ = jax.lax.scan(
+                    body, xx, (p, jnp.arange(per_stage)))
+                return out
+        else:
+            def stage_fn(p, xx):
+                def body(carry, pp):
+                    out, _, _ = self._block(pp, carry, None, False)
+                    return out, None
+
+                out, _ = jax.lax.scan(body, xx, p)
+                return out
 
         # Pipeline output is independent of M, so adapt M down to the
         # largest count this batch supports (B % M == 0, per-microbatch rows
@@ -323,7 +337,8 @@ class ViTTiny:
                 f"by the {n}-way pipe axis; none fits batch {b}"
             )
         return pipeline_apply(stage_fn, stage_params, x, m, mesh,
-                              circular_chunks=v)
+                              circular_chunks=v,
+                              rng=rng if use_dropout else None)
 
     def apply(self, params, state, x, *, train=False, rng=None):
         x = x.astype(self.compute_dtype)
@@ -341,7 +356,7 @@ class ViTTiny:
         zero_aux = jnp.zeros((), jnp.float32)
         zero_stats = self._moe_zero_stats() if is_moe else None
         if self.block_pipeline and self._pipe_axis_matches():
-            x = self._pipelined_blocks(params, x, use_dropout)
+            x = self._pipelined_blocks(params, x, use_dropout, rng)
             aux_total, stats_total = zero_aux, zero_stats
         elif self.scan_blocks:
             def body(carry, xs):

@@ -489,14 +489,6 @@ class TestPipelineInViT:
         mesh = make_mesh(MeshSpec(data=2, pipe=2))
         rng = np.random.default_rng(13)
         x = jnp.asarray(rng.normal(size=(4, 32, 32, 3)), jnp.float32)
-        # dropout on the pipeline path is rejected
-        model = get_model("vit_tiny", block_pipeline=2, **{
-            **self.KW, "dropout_rate": 0.1})
-        params, state = model.init(jax.random.PRNGKey(0), x)
-        with activate(mesh):
-            with pytest.raises(ValueError, match="dropout"):
-                model.apply(params, state, x, train=True,
-                            rng=jax.random.PRNGKey(1))
         # stage-count/pipe-axis MISMATCH falls back to the plain scan
         # (one model, any topology), loudly — and still computes correctly
         import logging
@@ -516,3 +508,112 @@ class TestPipelineInViT:
         p1, s1 = m1.init(jax.random.PRNGKey(0), x)
         out1, _ = m1.apply(p1, s1, x, train=False)
         assert np.isfinite(np.asarray(out1)).all()
+
+
+def _stage_fn_rng(params, x, key):
+    """Stochastic stage: dropout-style bernoulli mask from the threaded
+    key — the exact key stream is what's under test."""
+    y = jax.nn.relu(x @ params["w"] + params["b"])
+    keep = jax.random.bernoulli(key, 0.8, y.shape)
+    return jnp.where(keep, y / 0.8, 0.0)
+
+
+class TestPipelineRng:
+    """rng threading (VERDICT r4 weak #5 / next #4): the schedule's
+    per-(microbatch, global stage) key derivation must reproduce a
+    sequential replay with the SAME folded keys, exactly."""
+
+    def _sequential(self, stages, x, num_microbatches, base):
+        base = jax.random.fold_in(base, 0)  # data-shard fold at data=1
+        mbs = jnp.split(x, num_microbatches)
+        outs = []
+        for m, xm in enumerate(mbs):
+            for g, p in enumerate(stages):
+                key = jax.random.fold_in(
+                    jax.random.fold_in(base, m), g)
+                xm = _stage_fn_rng(p, xm, key)
+            outs.append(xm)
+        return jnp.concatenate(outs)
+
+    # data=1: per-device bernoulli draws are shard-shaped, so exact replay
+    # against a full-microbatch reference needs the batch unsharded (under
+    # DP the masks are a different-but-i.i.d. stream — statistically
+    # equivalent, covered by the determinism test below)
+    def test_rng_matches_sequential(self):
+        mesh = make_mesh(MeshSpec(data=1, pipe=4))
+        dim, batch, n_stages = 16, 32, 4
+        stages = _make_stages(jax.random.PRNGKey(0), n_stages, dim)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.PRNGKey(1), (batch, dim))
+        base = jax.random.PRNGKey(42)
+        expected = self._sequential(stages, x, 8, base)
+        got = pipeline_apply(_stage_fn_rng, stacked, x, num_microbatches=8,
+                             mesh=mesh, rng=base)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_circular_rng_matches_sequential(self):
+        mesh = make_mesh(MeshSpec(data=1, pipe=4))
+        dim, batch, n_stages, v = 16, 32, 4, 2
+        stages = _make_stages(jax.random.PRNGKey(2), n_stages * v, dim)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.PRNGKey(3), (batch, dim))
+        base = jax.random.PRNGKey(43)
+        expected = self._sequential(stages, x, 8, base)
+        got = pipeline_apply(_stage_fn_rng, stacked, x, num_microbatches=8,
+                             mesh=mesh, circular_chunks=v, rng=base)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_dp_shards_draw_decorrelated_masks(self, pipe_mesh):
+        """Each data rank folds its axis index into the key, so DP shards'
+        dropout masks are NOT bit-identical (code-review r5: with a
+        replicated key every rank drew the same shard-shaped mask)."""
+        dim, batch = 16, 32
+        stages = _make_stages(jax.random.PRNGKey(0), 4, dim)
+        stacked = stack_stage_params(stages)
+        x = jnp.ones((batch, dim))
+        out = pipeline_apply(_stage_fn_rng, stacked, x, num_microbatches=4,
+                             mesh=pipe_mesh, rng=jax.random.PRNGKey(42))
+        # rows of one microbatch live half on data rank 0, half on rank 1;
+        # identical inputs -> any difference comes from the masks
+        mb = np.asarray(out[:8])  # first microbatch, mb=8, 4 rows per rank
+        assert not np.array_equal(mb[:4], mb[4:])
+
+    def test_pipelined_vit_trains_with_dropout(self):
+        """The pp ladder config's model now trains with dropout like its
+        siblings: same rng -> same logits (deterministic key schedule),
+        train-mode != eval-mode, grads finite."""
+        from dist_mnist_tpu.cluster.mesh import activate
+        from dist_mnist_tpu.models import get_model
+        from dist_mnist_tpu.ops.losses import softmax_cross_entropy
+
+        kw = dict(depth=4, dim=32, heads=4, patch=8, pool="mean",
+                  dropout_rate=0.3, scan_blocks=True,
+                  compute_dtype=jnp.float32)
+        piped = get_model("vit_tiny", block_pipeline=2,
+                          pipeline_microbatches=2, **kw)
+        rng = np.random.default_rng(13)
+        x = jnp.asarray(rng.normal(size=(4, 32, 32, 3)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 10, (4,)), jnp.int32)
+        params, state = piped.init(jax.random.PRNGKey(0), x)
+        mesh = make_mesh(MeshSpec(data=2, pipe=2))
+        dk = jax.random.PRNGKey(7)
+        with activate(mesh):
+            run = jax.jit(lambda p, k: piped.apply(
+                p, state, x, train=True, rng=k)[0])
+            a = run(params, dk)
+            b = run(params, dk)
+            c = run(params, jax.random.PRNGKey(8))
+            ev, _ = jax.jit(lambda p: piped.apply(p, state, x))(params)
+
+            def loss(p, k):
+                logits, _ = piped.apply(p, state, x, train=True, rng=k)
+                return softmax_cross_entropy(logits, y)
+
+            g = jax.jit(jax.grad(loss))(params, dk)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+        assert not np.allclose(np.asarray(a), np.asarray(ev))
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(g))
