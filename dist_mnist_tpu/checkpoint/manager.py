@@ -27,11 +27,15 @@ except Exception:  # pragma: no cover - orbax is expected in this env
     _HAVE_ORBAX = False
 
 
-def _strip_metric_state(state):
-    """(state without top-level `_metric` model_state entries, the removed
-    key set). Those entries are additive health stats (train/step.py metric
-    contract) — a checkpoint written before a model grew them is still
-    fully valid; restore without them and refill from the target."""
+def _strip_metric_state(state, keep=frozenset()):
+    """(state without top-level `_metric` model_state entries — except
+    those in `keep` — and the full metric key set). Those entries are
+    additive health stats (train/step.py metric contract) — a checkpoint
+    written before a model grew them is still fully valid; restore
+    without the ones it lacks and refill from the target. `keep` lets the
+    healing ladder trim the target to exactly the checkpoint's OWN metric
+    set (a checkpoint with SOME metrics can't restore into a target
+    stripped of ALL of them — code review r5)."""
     import dataclasses
 
     ms = state.model_state
@@ -40,7 +44,8 @@ def _strip_metric_state(state):
     keys = {k for k in ms if isinstance(k, str) and k.endswith("_metric")}
     if not keys:
         return state, set()
-    stripped = {k: v for k, v in ms.items() if k not in keys}
+    stripped = {k: v for k, v in ms.items()
+                if k not in keys or k in keep}
     return dataclasses.replace(state, model_state=stripped), keys
 
 
@@ -110,6 +115,26 @@ def _flip_block_layouts(state, probe_only: bool = False):
     )
 
 
+def _is_structure_mismatch(err: Exception) -> bool:
+    """True when `err` is the pytree-structure-mismatch shape of failure
+    the healing ladder can possibly fix. Orbax raises these as ValueError
+    with a stable "…tree structures do not match" phrasing (measured:
+    "User-provided restore item and on-disk value metadata tree
+    structures do not match"); any KeyError counts (key lookups out of a
+    tree restore are structural; their str() carries no phrasing to
+    match). OSError (I/O), tensorstore read/checksum failures, etc. are
+    NOT healable and must propagate immediately."""
+    if isinstance(err, KeyError):
+        # str(KeyError('x')) is just "'x'" — no phrasing to match; a
+        # KeyError out of a tree restore is structural by nature
+        return True
+    if not isinstance(err, (ValueError, TypeError)):
+        return False
+    msg = str(err).lower()
+    return ("tree structure" in msg or "structures do not match" in msg
+            or "user-provided restore item" in msg)
+
+
 class CheckpointManager:
     """Save/restore `TrainState` with retention + async write.
 
@@ -177,6 +202,12 @@ class CheckpointManager:
         try:
             restored = self._restore_into(step, target_state)
         except Exception as err:
+            # only tree-structure mismatches enter the healing ladder
+            # (advisor r4: transient I/O or data corruption used to burn
+            # up to 3 more full restore attempts before the original
+            # error re-raised)
+            if not _is_structure_mismatch(err):
+                raise
             restored = self._restore_with_structure_healing(
                 step, target_state, err
             )
@@ -188,12 +219,18 @@ class CheckpointManager:
         order; anything else re-raises the ORIGINAL error (never the
         fallback attempts' — a corrupted checkpoint must not be
         misdiagnosed as a layout mismatch):
-        1. checkpoint predates `_metric` model-state entries (additive
-           health stats, parallel/moe.py) — restore without them, fill
+        1. checkpoint carries an older `_metric` model-state set (additive
+           health stats, parallel/moe.py) — trim the target's metric keys
+           to exactly the on-disk set (read from checkpoint metadata)
+           when known, else strip them all; restore, then fill the rest
            from the target's initial values;
         2. ViT scanned<->unrolled block layout flip;
         3. both at once."""
         stripped, metric_keys = _strip_metric_state(target_state)
+        ondisk = self._ondisk_model_state_keys(step)
+        keep = (metric_keys & ondisk) if ondisk is not None else set()
+        trimmed = (_strip_metric_state(target_state, keep=keep)[0]
+                   if keep and keep != metric_keys else None)
         has_blocks = _flip_block_layouts(target_state, probe_only=True)
         # alt targets built LAZILY and the flip MEMOIZED: the conversion
         # materializes a transient ~2x copy of params + optimizer slots on
@@ -206,15 +243,28 @@ class CheckpointManager:
                 flip_cache.append(_flip_block_layouts(target_state))
             return flip_cache[0]
 
+        # metadata showing the on-disk metric set already equals the
+        # target's proves the strip rungs can't help — skip them
+        strip_can_help = metric_keys and (ondisk is None
+                                          or keep != metric_keys)
         attempts = []
-        if metric_keys:
+        if trimmed is not None:
+            attempts.append(("with only the on-disk _metric entries "
+                             f"{sorted(keep)}",
+                             lambda: trimmed, False))
+        if strip_can_help:
             attempts.append(("without the _metric model-state entries "
                              f"{sorted(metric_keys)}",
                              lambda: stripped, False))
         if has_blocks:
             attempts.append(("in the flipped ViT block layout",
                              flipped, True))
-        if metric_keys and has_blocks:
+        if strip_can_help and has_blocks:
+            if trimmed is not None:
+                attempts.append(
+                    ("flipped layout + on-disk _metric entries only",
+                     lambda: _strip_metric_state(flipped(), keep=keep)[0],
+                     True))
             attempts.append(("flipped layout + no _metric entries",
                              lambda: _strip_metric_state(flipped())[0],
                              True))
@@ -243,6 +293,18 @@ class CheckpointManager:
                 restored, shardings,
             )
         raise err
+
+    def _ondisk_model_state_keys(self, step: int):
+        """Top-level model_state key set of the checkpoint on disk (from
+        Orbax tree metadata — no array reads), or None when metadata
+        isn't readable; the healing ladder then falls back to the
+        strip-everything rung."""
+        try:
+            tree = self._mgr.item_metadata(step).tree
+            ms = tree.get("model_state")
+            return set(ms.keys()) if hasattr(ms, "keys") else None
+        except Exception:
+            return None
 
     def _restore_into(self, step: int, target_state):
         abstract = jax.tree.map(

@@ -166,7 +166,8 @@ class ViTTiny:
         state = (
             {"moe_aux": jnp.zeros(()),
              "moe_drop_fraction_metric": jnp.zeros(()),
-             "moe_expert_load_metric": jnp.zeros((self.n_experts,))}
+             "moe_expert_load_metric": jnp.zeros((self.n_experts,)),
+             "moe_ep_engaged_metric": jnp.zeros(())}
             if self.mlp_impl == "moe" else {}
         )
         return params, state
@@ -214,7 +215,8 @@ class ViTTiny:
 
     def _moe_zero_stats(self):
         return {"drop_fraction": jnp.zeros(()),
-                "expert_load": jnp.zeros((self.n_experts,))}
+                "expert_load": jnp.zeros((self.n_experts,)),
+                "ep_engaged": jnp.zeros(())}
 
     def _block(self, p, x, layer_rng, use_dropout):
         """One pre-LN transformer block; returns (x, moe_aux, moe_stats)."""
@@ -390,6 +392,12 @@ class ViTTiny:
                 "moe_drop_fraction_metric": stats_total["drop_fraction"]
                 / self.depth,
                 "moe_expert_load_metric": stats_total["expert_load"]
+                / self.depth,
+                # 1.0 = every block dispatched over the expert axis; 0.0 =
+                # dense fallback (mesh's model axis != n_experts) — makes a
+                # not-actually-expert-parallel run visible in step outputs,
+                # not just a once-per-trace Python warning
+                "moe_ep_engaged_metric": stats_total["ep_engaged"]
                 / self.depth,
             }
         return logits.astype(jnp.float32), state

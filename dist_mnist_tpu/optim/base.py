@@ -22,6 +22,12 @@ State = Any
 
 
 class Optimizer(NamedTuple):
+    # State trees are built ONLY from dicts/tuples/namedtuples/lists of
+    # arrays (adam's {"m","v","count"} dicts, chain's tuple-of-states):
+    # checkpoint/manager.py::_flip_block_layouts recurses exactly those
+    # container types when healing block-layout flips, so a custom
+    # registered pytree node here would silently skip conversion of its
+    # mirrored slots (advisor r4) — extend that walker if you add one.
     init: Callable[[Params], State]
     update: Callable[[Grads, State, Params], tuple[Grads, State]]
 

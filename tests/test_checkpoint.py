@@ -218,3 +218,55 @@ def test_max_to_keep(tmp_path, state):
     assert mgr.latest_step() == 4
     assert len(steps) <= 2
     mgr.close()
+
+
+def test_io_error_skips_healing_ladder(tmp_path, state, monkeypatch):
+    """A non-structure failure (I/O, corruption) must propagate
+    immediately — the healing ladder used to run up to 3 extra full
+    restore attempts first (advisor r4)."""
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(state)
+    mgr.wait()
+    calls = []
+    monkeypatch.setattr(
+        mgr, "_restore_with_structure_healing",
+        lambda *a, **k: calls.append(1))
+    monkeypatch.setattr(
+        mgr, "_restore_into",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("disk on fire")))
+    with pytest.raises(OSError, match="disk on fire"):
+        mgr.restore(state)
+    assert not calls  # ladder never consulted
+    mgr.close()
+
+
+def test_partial_metric_checkpoint_restores(tmp_path, mesh8):
+    """A checkpoint whose model_state carries an OLDER `_metric` set (some
+    but not all of the target's) heals: the ladder trims the target to
+    the on-disk metric keys (read from checkpoint metadata) and refills
+    the rest from the target's initial values (code review r5 — stripping
+    ALL metrics mismatched in the other direction and orphaned every MoE
+    checkpoint saved before a new metric was added)."""
+    import dataclasses
+
+    opt = optim.adam(0.01)
+    sample = np.zeros((1, 32, 32, 3), np.uint8)
+    moe = get_model("vit_tiny", depth=2, dim=32, heads=4, patch=8,
+                    pool="mean", compute_dtype=jnp.float32,
+                    mlp_impl="moe", n_experts=2)
+    with mesh8:
+        st = shard_train_state(
+            create_train_state(moe, opt, jax.random.PRNGKey(0), sample),
+            mesh8)
+    # simulate the pre-ep_engaged checkpoint: drop one metric entry
+    old = dataclasses.replace(st, model_state={
+        k: v for k, v in st.model_state.items()
+        if k != "moe_ep_engaged_metric"})
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(old)
+    mgr.wait()
+    restored = mgr.restore(st)
+    assert sorted(restored.model_state) == sorted(st.model_state)
+    # the refilled entry carries the target's initial value
+    assert float(restored.model_state["moe_ep_engaged_metric"]) == 0.0
+    mgr.close()

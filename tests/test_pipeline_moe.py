@@ -342,6 +342,9 @@ class TestMoEInViT:
                                    rtol=2e-4, atol=2e-5)
         np.testing.assert_allclose(float(dense_state["moe_aux"]),
                                    float(ep_state["moe_aux"]), rtol=2e-4)
+        # EP engagement is a step-visible fact, not just a Python warning
+        assert float(ep_state["moe_ep_engaged_metric"]) == 1.0
+        assert float(dense_state["moe_ep_engaged_metric"]) == 0.0
 
     def test_aux_loss_reaches_gradients(self, mesh_tp):
         """The load-balance aux rides model_state into the train loss:
@@ -617,3 +620,54 @@ class TestPipelineRng:
         assert not np.allclose(np.asarray(a), np.asarray(ev))
         assert all(np.isfinite(np.asarray(l)).all()
                    for l in jax.tree.leaves(g))
+
+
+class TestMoEEngagement:
+    """moe_ep_engaged surfacing + top_k validation (VERDICT r4 weak #6 /
+    next #5; ADVICE r4)."""
+
+    def _setup(self, n_experts=4):
+        params = init_moe(jax.random.PRNGKey(0), dim=16, hidden=32,
+                          n_experts=n_experts)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, 16))
+        return params, x
+
+    def test_adaptive_engaged_on_matching_axis(self, ep_mesh):
+        from dist_mnist_tpu.cluster.mesh import activate
+        from dist_mnist_tpu.parallel.moe import moe_ffn_adaptive
+
+        params, x = self._setup()
+        with activate(ep_mesh):
+            _, _, stats = jax.jit(moe_ffn_adaptive)(params, x)
+        assert float(stats["ep_engaged"]) == 1.0
+
+    def test_adaptive_dense_fallback_reports_zero(self, mesh_tp):
+        """model axis 2 != 4 experts: dense fallback, and the stats SAY so
+        — a jit-cached second call keeps saying so (the log warning
+        doesn't)."""
+        from dist_mnist_tpu.cluster.mesh import activate
+        from dist_mnist_tpu.parallel.moe import moe_ffn_adaptive
+
+        params, x = self._setup(n_experts=4)
+        with activate(mesh_tp):  # model axis = 2
+            fn = jax.jit(moe_ffn_adaptive)
+            _, _, stats = fn(params, x)
+            _, _, stats2 = fn(params, x)  # cached trace, same visibility
+        assert float(stats["ep_engaged"]) == 0.0
+        assert float(stats2["ep_engaged"]) == 0.0
+
+    def test_adaptive_no_mesh_reports_zero(self):
+        from dist_mnist_tpu.parallel.moe import moe_ffn_adaptive
+
+        params, x = self._setup()
+        _, _, stats = moe_ffn_adaptive(params, x)
+        assert float(stats["ep_engaged"]) == 0.0
+
+    def test_top_k_out_of_range_raises(self):
+        from dist_mnist_tpu.parallel.moe import moe_ffn_dense
+
+        params, x = self._setup(n_experts=4)
+        with pytest.raises(ValueError, match="top_k"):
+            moe_ffn_dense(params, x, top_k=5)
+        with pytest.raises(ValueError, match="top_k"):
+            moe_ffn_dense(params, x, top_k=0)
