@@ -83,6 +83,9 @@ class ViTTiny:
     # mesh's pipe axis equals N; on any other mesh the same model falls
     # back to the plain scan — one model, any topology.
     pipeline_microbatches: int = 8  # GPipe M; bubble = (N-1)/(M+N-1)
+    pipeline_skip_bubble: bool = False  # lax.cond the stage fn so
+    # fill/drain ticks skip its compute entirely (identical outputs;
+    # parallel/pipeline.py skip_bubble). Off until measured on multi-chip.
     pipeline_circular: int = 0  # v>1: circular/interleaved schedule — each
     # pipe rank holds v non-adjacent chunks of depth/(N*v) blocks; the
     # fill/drain bubble shrinks from (N-1) stage-times to (N-1) chunk-times
@@ -340,7 +343,8 @@ class ViTTiny:
             )
         return pipeline_apply(stage_fn, stage_params, x, m, mesh,
                               circular_chunks=v,
-                              rng=rng if use_dropout else None)
+                              rng=rng if use_dropout else None,
+                              skip_bubble=self.pipeline_skip_bubble)
 
     def apply(self, params, state, x, *, train=False, rng=None):
         x = x.astype(self.compute_dtype)

@@ -1,13 +1,15 @@
 #!/usr/bin/env python
 """Pipeline-parallel overhead measurement (VERDICT r3 weak 4 / next 5).
 
-Times the SAME ViT stack three ways on one 8-device mesh and prints a JSON
+Times the SAME ViT stack five ways on one 8-device mesh and prints a JSON
 line per variant plus the predicted-vs-measured overhead summary:
 
-  dp        plain scanned stack, all 8 devices on `data` (the thing PP
-            competes with when params fit)
-  gpipe     block_pipeline=4 (data=2 x pipe=4), GPipe schedule
-  circular  block_pipeline=4, pipeline_circular=3 (data=2 x pipe=4)
+  dp             plain scanned stack, all 8 devices on `data` (the thing
+                 PP competes with when params fit)
+  gpipe          block_pipeline=4 (data=2 x pipe=4), GPipe schedule
+  circular       block_pipeline=4, pipeline_circular=3 (data=2 x pipe=4)
+  gpipe_skip     gpipe with fill/drain stage compute lax.cond'd away
+  circular_skip  circular, ditto (pipeline.py skip_bubble)
 
 Tick math (parallel/pipeline.py): per microbatch-stage of compute, the
 whole-batch cost on the SAME chip count is
@@ -21,7 +23,7 @@ schedule gets to the dp floor.
 
 CPU smoke: JAX_PLATFORMS=cpu + XLA_FLAGS=--xla_force_host_platform_device_
 count=8 runs the full comparison on the fake mesh. There the `loss_sanity`
-equality across variants is the meaningful output (all three schedules
+equality across variants is the meaningful output (all five variants
 compute the same function); the TIME ratios are NOT — the 8 fake devices
 share one physical core, so cross-mesh walltime comparisons are artifacts
 (measured on this box: DP reads 5x slower than GPipe, the opposite of the
@@ -89,6 +91,18 @@ def main():
                                pipeline_circular=v_chunks,
                                pipeline_microbatches=m_micro, **kw),
                      MeshSpec(data=2, pipe=s_stages)),
+        # skip-bubble twins: fill/drain ticks lax.cond away the stage
+        # compute — measures whether XLA rewards the branch or loses more
+        # to inhibited compute/ppermute overlap (pipeline.py skip_bubble)
+        "gpipe_skip": (get_model("vit_tiny", block_pipeline=s_stages,
+                                 pipeline_microbatches=m_micro,
+                                 pipeline_skip_bubble=True, **kw),
+                       MeshSpec(data=2, pipe=s_stages)),
+        "circular_skip": (get_model("vit_tiny", block_pipeline=s_stages,
+                                    pipeline_circular=v_chunks,
+                                    pipeline_microbatches=m_micro,
+                                    pipeline_skip_bubble=True, **kw),
+                          MeshSpec(data=2, pipe=s_stages)),
     }
     predicted = {
         "dp": 1.0,
@@ -96,6 +110,14 @@ def main():
         "circular": (m_micro * v_chunks + s_stages - 1)
         / (m_micro * v_chunks),
     }
+    # skip does NOT change the predicted wall: the bubble is a dependency
+    # -chain property (rank s+1's tick t+1 needs rank s's tick t), and
+    # garbage ticks fill otherwise-IDLE ranks — they were never on the
+    # critical path. Expect skip ~== unskipped wall; the win is FLOPs/
+    # energy/HBM traffic. A skip slower than its twin = the cond's cost
+    # (lost compute/ppermute overlap), which is what this measures.
+    predicted["gpipe_skip"] = predicted["gpipe"]
+    predicted["circular_skip"] = predicted["circular"]
 
     results = {}
     for name, (model, spec) in variants.items():
@@ -133,7 +155,8 @@ def main():
             name: {
                 "measured_vs_dp": round(results[name] / dp, 3),
                 "predicted_vs_dp": round(predicted[name], 3),
-            } for name in ("gpipe", "circular")
+            } for name in ("gpipe", "circular", "gpipe_skip",
+                           "circular_skip")
         },
         "note": (
             "CPU fake mesh: devices share one core — time ratios are "

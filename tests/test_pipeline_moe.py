@@ -671,3 +671,49 @@ class TestMoEEngagement:
             moe_ffn_dense(params, x, top_k=5)
         with pytest.raises(ValueError, match="top_k"):
             moe_ffn_dense(params, x, top_k=0)
+
+
+class TestPipelineSkipBubble:
+    """skip_bubble wraps the stage in lax.cond(valid, fn, id): fill/drain
+    ticks skip the compute, outputs must be IDENTICAL (garbage ticks only
+    ever feed garbage ticks). VERDICT r4 weak #4 / next #6."""
+
+    def _seq(self, stages, x):
+        for p in stages:
+            x = _stage_fn(p, x)
+        return x
+
+    @pytest.mark.parametrize("v", [1, 2])
+    def test_matches_sequential_and_unskipped(self, pipe_mesh, v):
+        dim, batch, n_stages = 16, 32, 4
+        stages = _make_stages(jax.random.PRNGKey(20 + v), n_stages * v, dim)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.PRNGKey(21), (batch, dim))
+        expected = self._seq(stages, x)
+        kw = dict(num_microbatches=8, mesh=pipe_mesh, circular_chunks=v)
+        got = pipeline_apply(_stage_fn, stacked, x, skip_bubble=True, **kw)
+        base = pipeline_apply(_stage_fn, stacked, x, **kw)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
+
+    def test_differentiable_with_rng(self, pipe_mesh):
+        """cond + fori_loop + rng threading all compose under jax.grad."""
+        dim, batch, n_stages = 8, 16, 4
+        stages = _make_stages(jax.random.PRNGKey(22), n_stages, dim)
+        stacked = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.PRNGKey(23), (batch, dim))
+        base = jax.random.PRNGKey(24)
+
+        def loss(sp, skip):
+            y = pipeline_apply(_stage_fn_rng, sp, x, num_microbatches=4,
+                               mesh=pipe_mesh, rng=base, skip_bubble=skip)
+            return jnp.sum(y ** 2)
+
+        g_skip = jax.grad(lambda sp: loss(sp, True))(stacked)
+        g_base = jax.grad(lambda sp: loss(sp, False))(stacked)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            g_skip, g_base,
+        )
