@@ -115,7 +115,7 @@ def main() -> int:
         f"{datetime.date.today().isoformat()}, device_get stop-clock, "
         f"measure_all battery ({os.path.basename(args.outdir)})"
     )
-    out = json.dumps(doc, indent=2)
+    out = json.dumps(doc, indent=2, ensure_ascii=False)
     print(out)
     if args.write:
         with open(ANCHOR_PATH, "w") as fh:
