@@ -61,6 +61,10 @@ class ViTTiny:
     compute_dtype: jnp.dtype = jnp.bfloat16
     # "xla" | "flash" | "ring" | "ring_flash" | "ulysses"
     attention_impl: str = "xla"
+    attention_block_k: int | None = None  # flash/ring_flash only: stream
+    # K/V through VMEM in tiles of this many keys (online softmax,
+    # ops/pallas/flash_attention block_k) instead of holding the full
+    # (local) key axis resident. None = full-K (proven small-S path).
     pool: str = "cls"  # "cls" | "mean" (mean keeps token count a power of
     # two — required when the sequence dim is sharded, e.g. ring attention)
     mlp_impl: str = "dense"  # "dense" | "moe" (switch-routed expert FFN,
@@ -187,7 +191,8 @@ class ViTTiny:
             # (a bare pallas_call would replicate — parallel/flash.py)
             from dist_mnist_tpu.parallel.flash import flash_attention_sharded
 
-            out = flash_attention_sharded(q, k, v)
+            out = flash_attention_sharded(q, k, v,
+                                          block_k=self.attention_block_k)
         elif self.attention_impl in ("ring", "ring_flash"):
             from dist_mnist_tpu.parallel.ring_attention import ring_attention
 
@@ -197,7 +202,8 @@ class ViTTiny:
             out = ring_attention(
                 q, k, v,
                 impl="flash" if self.attention_impl == "ring_flash"
-                else "xla")
+                else "xla",
+                block_k=self.attention_block_k)
         elif self.attention_impl == "ulysses":
             from dist_mnist_tpu.parallel.ulysses import ulysses_attention
 

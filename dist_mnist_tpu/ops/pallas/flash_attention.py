@@ -6,10 +6,20 @@ grid and keeps each [block_q, S] score tile in VMEM — scores never touch
 HBM. Softmax is computed per tile in f32 (exact, since the full key axis is
 resident per tile); the MXU sees two GEMMs per tile.
 
-Layout: grid = (B*H, S/block_q); per program: q tile [block_q, D], full K/V
-[S, D] for that (batch, head). VMEM budget at default block_q=128, S<=8192,
-D<=128, bf16: ~2 MB score tile + ~4 MB K/V — inside the ~16 MB/core VMEM.
-For longer S, shard the sequence first (parallel/ring_attention.py) and let
+Two kernel families, selected by `block_k`:
+- `block_k=None` (default): full K/V resident per q tile. Layout: grid =
+  (B*H, S/block_q); per program: q tile [block_q, D], full K/V [S, D] for
+  that (batch, head). VMEM budget at default block_q=128, S<=8192, D<=128,
+  bf16: ~2 MB score tile + ~4 MB K/V — inside the ~16 MB/core VMEM.
+- `block_k=N`: ONLINE-softmax streaming (the classic flash recipe) — a
+  third, sequential grid dimension walks K/V (and the corresponding
+  resident axis of each backward kernel) one [block_k, D] tile at a time
+  with running max/denominator/accumulator in f32 VMEM scratch, lifting
+  the resident-axis ceiling for long single-device S. Both families are
+  pinned equal to each other and to the dense reference
+  (tests/test_parallel_attention.py::TestFlashBlockK).
+
+For even longer S, shard the sequence (parallel/ring_attention.py) and let
 each device run this kernel on its local block: `flash_attention_lse`
 returns the merge-ready `(out, lse)` pair and `ring_attention_inner`
 (`impl="flash"`) consumes it as a blockwise-LSE contribution `(num=out,
@@ -124,6 +134,135 @@ def _attn_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _attn_fwd_kernel_kt(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                        m_scr, l_scr, acc_scr, *, scale: float, s_real: int,
+                        block_k: int, nk: int):
+    """Online-softmax forward: grid (BH, nq, nk) with the key axis as the
+    INNERMOST (sequential, 'arbitrary') dimension — K/V stream through
+    VMEM one [block_k, D] tile at a time while running max/denominator/
+    accumulator live in scratch. Removes the full-K-resident VMEM ceiling
+    of `_attn_fwd_kernel` (the classic flash recipe; selected via
+    `block_k=`)."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    k = k_ref[0]  # [block_k, D]
+    v = v_ref[0]
+    logits = jax.lax.dot_general(
+        q, k.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, block_k]
+    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(col < s_real, logits, -1e30)
+    m_prev, l_prev = m_scr[...], l_scr[...]
+    m_cur = jnp.maximum(m_prev, jnp.max(logits, axis=-1))
+    alpha = jnp.exp(m_prev - m_cur)  # rescale of everything accumulated
+    p = jnp.exp(logits - m_cur[:, None])
+    l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+        p.astype(v.dtype), v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[...] = m_cur
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[...] / l_scr[...][:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l_scr[...])
+
+
+def _attn_dq_kernel_kt(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dq_ref, acc_scr, *, scale: float, s_real: int,
+                       block_k: int, nk: int):
+    """dQ with the key axis streamed (grid (BH, nq, nk), nk innermost):
+    no rescale pass needed — the forward's LSE makes p exact per tile, so
+    dq accumulates tile-by-tile in f32 scratch."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    logits = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(col < s_real, logits, -1e30)  # forward's mask
+    p = jnp.exp(logits - lse[:, None])
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    acc_scr[...] = acc_scr[...] + jax.lax.dot_general(
+        ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _attn_dkv_kernel_qt(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                        nq: int):
+    """dK/dV with the QUERY axis streamed (grid (BH, nk, nq), nq
+    innermost). Query padding is zero-filled (q=0, dO=0, delta=0) so
+    padded tiles contribute zero, exactly as in `_attn_dkv_kernel`."""
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+    v = v_ref[0].astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    logits_t = jax.lax.dot_general(  # K_tile @ Q_tile^T
+        k, q, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    p_t = jnp.exp(logits_t - lse[None, :])
+    dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+        p_t, do, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp_t = jax.lax.dot_general(
+        v, do, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds_t = p_t * (dp_t - delta[None, :])
+    dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+        ds_t, q, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
@@ -137,53 +276,87 @@ def _from_bh(x, b, h, s, d):  # [B*H, length, D] -> [B,S,H,D]
     return jnp.moveaxis(x[:, :s].reshape(b, h, s, d), 1, 2)
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
-def _flash_fwd_impl(q, k, v, block_q: int, interpret: bool):
+_SEQ3 = ("parallel", "parallel", "arbitrary")
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "interpret", "block_k"))
+def _flash_fwd_impl(q, k, v, block_q: int, interpret: bool,
+                    block_k: int | None = None):
     b, s, h, d = q.shape
     scale = d**-0.5
-    s_pad = _round_up(s, 128)
+    s_pad = _round_up(s, block_k or 128)
     q_pad = _round_up(s, block_q)
 
     qb = _to_bh(q, b, h, s, d, q_pad)
     kb = _to_bh(k, b, h, s, d, s_pad)
     vb = _to_bh(v, b, h, s, d, s_pad)
-    grid = (b * h, q_pad // block_q)
-    out, lse = pl.pallas_call(
-        functools.partial(_attn_fwd_kernel, scale=scale, s_real=s),
-        out_shape=(
-            jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, q_pad), jnp.float32),
-        ),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j),
-                         memory_space=pltpu.VMEM),
-        ),
-        interpret=interpret,
-    )(qb, kb, vb)
+    out_shape = (
+        jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+        jax.ShapeDtypeStruct((b * h, q_pad), jnp.float32),
+    )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, *ki: (i, j, 0),
+                          memory_space=pltpu.VMEM)
+    o_specs = (
+        pl.BlockSpec((1, block_q, d), lambda i, j, *ki: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q), lambda i, j, *ki: (i, j),
+                     memory_space=pltpu.VMEM),
+    )
+    if block_k is None:
+        out, lse = pl.pallas_call(
+            functools.partial(_attn_fwd_kernel, scale=scale, s_real=s),
+            out_shape=out_shape,
+            grid=(b * h, q_pad // block_q),
+            in_specs=[
+                q_spec,
+                pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=o_specs,
+            interpret=interpret,
+        )(qb, kb, vb)
+    else:
+        nk = s_pad // block_k
+        kv_spec = pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
+                               memory_space=pltpu.VMEM)
+        out, lse = pl.pallas_call(
+            functools.partial(_attn_fwd_kernel_kt, scale=scale, s_real=s,
+                              block_k=block_k, nk=nk),
+            out_shape=out_shape,
+            grid=(b * h, q_pad // block_q, nk),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=o_specs,
+            scratch_shapes=[
+                pltpu.VMEM((block_q,), jnp.float32),
+                pltpu.VMEM((block_q,), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=_SEQ3),
+            interpret=interpret,
+        )(qb, kb, vb)
     return _from_bh(out, b, h, s, d), lse
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "interpret", "block_k"))
 def _flash_bwd_impl(q, k, v, out, lse, do, dlse, block_q: int,
-                    interpret: bool):
+                    interpret: bool, block_k: int | None = None):
     """dlse is the [B,H,S] f32 cotangent of the returned LSE (zeros for the
     out-only entry point). It needs no kernel change: dlogits =
     p*(dp - delta + dlse) row-wise, so it folds into the delta argument as
-    `delta - dlse`; dV is p^T @ dO, independent of lse."""
+    `delta - dlse`; dV is p^T @ dO, independent of lse.
+
+    `block_k=None` (default): dQ holds full K/V per tile and dK/dV holds
+    full Q — the proven small-S path. With `block_k`, both kernels stream
+    their resident axis through VMEM (grid accumulation in f32 scratch),
+    matching the forward's online path."""
     b, s, h, d = q.shape
     scale = d**-0.5
-    s_pad = _round_up(s, 128)
+    s_pad = _round_up(s, block_k or 128)
     q_pad = _round_up(s, block_q)
 
     qb = _to_bh(q, b, h, s, d, q_pad)
@@ -199,85 +372,133 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, block_q: int,
         dlse.astype(jnp.float32).reshape(b * h, s),
         ((0, 0), (0, q_pad - s)))
 
-    vec_spec_q = pl.BlockSpec((1, block_q), lambda i, j: (i, j),
+    vec_spec_q = pl.BlockSpec((1, block_q), lambda i, j, *kk: (i, j),
                               memory_space=pltpu.VMEM)
-    mat_full_s = pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
-                              memory_space=pltpu.VMEM)
-    mat_tile_q = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0),
+    mat_tile_q = pl.BlockSpec((1, block_q, d), lambda i, j, *kk: (i, j, 0),
                               memory_space=pltpu.VMEM)
 
-    dqb = pl.pallas_call(
-        functools.partial(_attn_dq_kernel, scale=scale, s_real=s),
-        out_shape=jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
-        grid=(b * h, q_pad // block_q),
-        in_specs=[mat_tile_q, mat_full_s, mat_full_s, mat_tile_q,
-                  vec_spec_q, vec_spec_q],
-        out_specs=mat_tile_q,
-        interpret=interpret,
-    )(qb, kb, vb, dob, lse, delta)
+    if block_k is None:
+        mat_full_s = pl.BlockSpec((1, s_pad, d), lambda i, j: (i, 0, 0),
+                                  memory_space=pltpu.VMEM)
+        dqb = pl.pallas_call(
+            functools.partial(_attn_dq_kernel, scale=scale, s_real=s),
+            out_shape=jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+            grid=(b * h, q_pad // block_q),
+            in_specs=[mat_tile_q, mat_full_s, mat_full_s, mat_tile_q,
+                      vec_spec_q, vec_spec_q],
+            out_specs=mat_tile_q,
+            interpret=interpret,
+        )(qb, kb, vb, dob, lse, delta)
+    else:
+        nk = s_pad // block_k
+        kv_tile = pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
+                               memory_space=pltpu.VMEM)
+        dqb = pl.pallas_call(
+            functools.partial(_attn_dq_kernel_kt, scale=scale, s_real=s,
+                              block_k=block_k, nk=nk),
+            out_shape=jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+            grid=(b * h, q_pad // block_q, nk),
+            in_specs=[mat_tile_q, kv_tile, kv_tile, mat_tile_q,
+                      vec_spec_q, vec_spec_q],
+            out_specs=mat_tile_q,
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=_SEQ3),
+            interpret=interpret,
+        )(qb, kb, vb, dob, lse, delta)
 
-    block_k = 128
-    mat_tile_k = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0),
+    bk_tile = 128
+    mat_tile_k = pl.BlockSpec((1, bk_tile, d), lambda i, j, *qq: (i, j, 0),
                               memory_space=pltpu.VMEM)
-    mat_full_q = pl.BlockSpec((1, q_pad, d), lambda i, j: (i, 0, 0),
-                              memory_space=pltpu.VMEM)
-    vec_full_q = pl.BlockSpec((1, q_pad), lambda i, j: (i, 0),
-                              memory_space=pltpu.VMEM)
-    dkb, dvb = pl.pallas_call(
-        functools.partial(_attn_dkv_kernel, scale=scale),
-        out_shape=(
-            jax.ShapeDtypeStruct((b * h, s_pad, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, s_pad, d), v.dtype),
-        ),
-        grid=(b * h, s_pad // block_k),
-        in_specs=[mat_tile_k, mat_tile_k, mat_full_q, mat_full_q,
-                  vec_full_q, vec_full_q],
-        out_specs=(mat_tile_k, mat_tile_k),
-        interpret=interpret,
-    )(kb, vb, qb, dob, lse, delta)
+    dkv_shape = (
+        jax.ShapeDtypeStruct((b * h, s_pad, d), k.dtype),
+        jax.ShapeDtypeStruct((b * h, s_pad, d), v.dtype),
+    )
+    if block_k is None:
+        mat_full_q = pl.BlockSpec((1, q_pad, d), lambda i, j: (i, 0, 0),
+                                  memory_space=pltpu.VMEM)
+        vec_full_q = pl.BlockSpec((1, q_pad), lambda i, j: (i, 0),
+                                  memory_space=pltpu.VMEM)
+        dkb, dvb = pl.pallas_call(
+            functools.partial(_attn_dkv_kernel, scale=scale),
+            out_shape=dkv_shape,
+            grid=(b * h, s_pad // bk_tile),
+            in_specs=[mat_tile_k, mat_tile_k, mat_full_q, mat_full_q,
+                      vec_full_q, vec_full_q],
+            out_specs=(mat_tile_k, mat_tile_k),
+            interpret=interpret,
+        )(kb, vb, qb, dob, lse, delta)
+    else:
+        nq = q_pad // block_q
+        q_tile_inner = pl.BlockSpec((1, block_q, d),
+                                    lambda i, j, qi: (i, qi, 0),
+                                    memory_space=pltpu.VMEM)
+        vec_tile_inner = pl.BlockSpec((1, block_q),
+                                      lambda i, j, qi: (i, qi),
+                                      memory_space=pltpu.VMEM)
+        dkb, dvb = pl.pallas_call(
+            functools.partial(_attn_dkv_kernel_qt, scale=scale, nq=nq),
+            out_shape=dkv_shape,
+            grid=(b * h, s_pad // bk_tile, nq),
+            in_specs=[mat_tile_k, mat_tile_k, q_tile_inner, q_tile_inner,
+                      vec_tile_inner, vec_tile_inner],
+            out_specs=(mat_tile_k, mat_tile_k),
+            scratch_shapes=[pltpu.VMEM((bk_tile, d), jnp.float32),
+                            pltpu.VMEM((bk_tile, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=_SEQ3),
+            interpret=interpret,
+        )(kb, vb, qb, dob, lse, delta)
 
     return (_from_bh(dqb, b, h, s, d), _from_bh(dkb, b, h, s, d),
             _from_bh(dvb, b, h, s, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, block_q: int, interpret: bool):
-    out, _ = _flash_fwd_impl(q, k, v, block_q, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, block_q: int, interpret: bool,
+                     block_k: int | None):
+    out, _ = _flash_fwd_impl(q, k, v, block_q, interpret, block_k)
     return out
 
 
-def _flash_attention_fwd(q, k, v, block_q: int, interpret: bool):
-    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret)
+def _flash_attention_fwd(q, k, v, block_q: int, interpret: bool,
+                         block_k: int | None):
+    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret, block_k)
     return out, (q, k, v, out, lse)
 
 
-def _flash_attention_bwd(block_q: int, interpret: bool, res, do):
+def _flash_attention_bwd(block_q: int, interpret: bool,
+                         block_k: int | None, res, do):
     q, k, v, out, lse = res
     zero_dlse = jnp.zeros((q.shape[0], q.shape[2], q.shape[1]), jnp.float32)
     return _flash_bwd_impl(q, k, v, out, lse, do, zero_dlse, block_q,
-                           interpret)
+                           interpret, block_k)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_lse(q, k, v, block_q: int, interpret: bool):
-    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_lse(q, k, v, block_q: int, interpret: bool,
+                         block_k: int | None):
+    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret, block_k)
     b, s, h, _ = q.shape
     return out, lse[:, :s].reshape(b, h, s)
 
 
-def _flash_attention_lse_fwd(q, k, v, block_q: int, interpret: bool):
-    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret)
+def _flash_attention_lse_fwd(q, k, v, block_q: int, interpret: bool,
+                             block_k: int | None):
+    out, lse = _flash_fwd_impl(q, k, v, block_q, interpret, block_k)
     b, s, h, _ = q.shape
     return (out, lse[:, :s].reshape(b, h, s)), (q, k, v, out, lse)
 
 
-def _flash_attention_lse_bwd(block_q: int, interpret: bool, res, cts):
+def _flash_attention_lse_bwd(block_q: int, interpret: bool,
+                             block_k: int | None, res, cts):
     q, k, v, out, lse = res
     do, dlse = cts
-    return _flash_bwd_impl(q, k, v, out, lse, do, dlse, block_q, interpret)
+    return _flash_bwd_impl(q, k, v, out, lse, do, dlse, block_q, interpret,
+                           block_k)
 
 
 _flash_attention_lse.defvjp(_flash_attention_lse_fwd,
@@ -292,7 +513,17 @@ def _quantize_block_q(block_q: int, s: int) -> int:
     return min(_round_up(block_q, 128), _round_up(s, 128))
 
 
+def _quantize_block_k(block_k: int | None, s: int) -> int | None:
+    if block_k is None:
+        return None
+    bk = min(_round_up(block_k, 128), _round_up(s, 128))
+    # streaming only pays off with >1 tile; a single tile IS the full-K
+    # path, so take the simpler kernel
+    return bk if _round_up(s, bk) // bk > 1 else None
+
+
 def flash_attention(q, k, v, *, block_q: int = 128,
+                    block_k: int | None = None,
                     interpret: bool | None = None):
     """[B,S,H,D] self-attention, fused in VMEM. Drop-in for
     ops/nn.dot_product_attention (non-causal), forward and backward —
@@ -301,14 +532,25 @@ def flash_attention(q, k, v, *, block_q: int = 128,
     `block_q` is quantized to 128-lane multiples (rounded UP, capped at the
     padded sequence length): requesting e.g. block_q=8 runs with 128, so it
     cannot be tuned *below* 128 for VMEM headroom — shrink S per device
-    (sequence-shard, see flash_attention_lse) instead."""
+    (sequence-shard, see flash_attention_lse) instead.
+
+    `block_k=None` (default) keeps the full key axis resident per q tile
+    (exact per-tile softmax; VMEM budget caps single-device S at ~8192).
+    Setting `block_k` (same 128-quantization) selects the ONLINE-softmax
+    kernels: K/V (and, in the backward, the dQ kernel's K axis and the
+    dK/dV kernel's Q axis) stream through VMEM one tile at a time with
+    running max/denominator in scratch — the classic flash recipe, lifting
+    the resident-axis ceiling for long single-device sequences. Both paths
+    are numerically pinned against each other and the dense reference."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash_attention(q, k, v, _quantize_block_q(block_q, q.shape[1]),
-                            interpret)
+                            interpret,
+                            _quantize_block_k(block_k, q.shape[1]))
 
 
 def flash_attention_lse(q, k, v, *, block_q: int = 128,
+                        block_k: int | None = None,
                         interpret: bool | None = None):
     """Like `flash_attention` but returns `(out [B,S,H,D], lse [B,H,S])` —
     the merge-ready pair for blockwise/ring composition: a caller holding
@@ -317,8 +559,10 @@ def flash_attention_lse(q, k, v, *, block_q: int = 128,
     running max `lse_b`). Differentiable in BOTH outputs: the lse cotangent
     folds into the same backward kernels as `delta - dlse` (see
     _flash_bwd_impl), which is what makes ring(flash-local) train-grade.
-    Same block_q quantization as `flash_attention`."""
+    Same block_q/block_k quantization and kernel selection as
+    `flash_attention`."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash_attention_lse(
-        q, k, v, _quantize_block_q(block_q, q.shape[1]), interpret)
+        q, k, v, _quantize_block_q(block_q, q.shape[1]), interpret,
+        _quantize_block_k(block_k, q.shape[1]))

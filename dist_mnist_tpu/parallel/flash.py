@@ -16,6 +16,8 @@ closed at every dispatch point, not special-cased in one model.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 from jax.sharding import PartitionSpec as P, get_abstract_mesh
 
@@ -23,18 +25,20 @@ from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
 from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
 
 
-def flash_attention_sharded(q, k, v):
+def flash_attention_sharded(q, k, v, block_k=None):
     """[B,S,H,D] flash attention on any ambient mesh.
 
     No/singleton model axis: the plain kernel. >1 model axis: shard_map
     over heads — refusing (at trace time, with a clear error instead of a
     deep XLA partitioning one) a head count the axis cannot divide.
+    `block_k` selects the online-softmax streaming kernels (see
+    flash_attention).
     """
     mesh = get_abstract_mesh()
     shape = getattr(mesh, "shape", {}) if mesh is not None else {}
     m = shape.get(MODEL_AXIS, 1)
     if m <= 1:
-        return flash_attention(q, k, v)
+        return flash_attention(q, k, v, block_k=block_k)
     heads = q.shape[2]
     if heads % m:
         raise ValueError(
@@ -50,6 +54,7 @@ def flash_attention_sharded(q, k, v):
     data = shape.get(DATA_AXIS, 1)
     spec = P(DATA_AXIS if data > 1 and q.shape[0] % data == 0 else None,
              None, MODEL_AXIS, None)
-    fn = jax.shard_map(flash_attention, mesh=mesh, in_specs=(spec,) * 3,
-                       out_specs=spec, check_vma=False)
+    fn = jax.shard_map(
+        functools.partial(flash_attention, block_k=block_k),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
     return fn(q, k, v)

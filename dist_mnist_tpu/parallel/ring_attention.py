@@ -37,7 +37,7 @@ from dist_mnist_tpu.parallel.collectives import ring_shift
 
 
 def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
-                         impl: str = "xla"):
+                         impl: str = "xla", block_k: int | None = None):
     """Blockwise-LSE ring attention; q/k/v are this device's [B,Sl,H,D].
 
     `impl` selects how each device computes its LOCAL q x k_block piece:
@@ -79,7 +79,11 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
             flash_attention_lse,
         )
 
-        out, lse = flash_attention_lse(q, k_blk, v_blk)  # [B,Sq,H,D],[B,H,Sq]
+        # block_k streams K/V tiles through VMEM *within* the local
+        # block too (online softmax) — ring bounds HBM, block_k bounds
+        # VMEM residency
+        out, lse = flash_attention_lse(q, k_blk, v_blk,
+                                       block_k=block_k)  # [B,Sq,H,D],[B,H,Sq]
         return out.astype(jnp.float32), jnp.ones_like(lse), lse
 
     block = block_flash if impl == "flash" else block_xla
@@ -119,7 +123,7 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
-                        impl: str = "xla"):
+                        impl: str = "xla", block_k: int | None = None):
     """shard_map wrapper over [B,S,H,D]: batch stays sharded over `data`,
     heads over `model`, and the sequence dim rings over `axis_name` — the
     full hybrid DP x TP x SP layout in one spec. Requires B % data == 0,
@@ -129,7 +133,8 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
 
     spec = P(DATA_AXIS, axis_name, MODEL_AXIS, None)
     fn = jax.shard_map(
-        partial(ring_attention_inner, axis_name=axis_name, impl=impl),
+        partial(ring_attention_inner, axis_name=axis_name, impl=impl,
+                block_k=block_k),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -138,7 +143,8 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
     return fn(q, k, v)
 
 
-def ring_attention(q, k, v, impl: str = "xla"):
+def ring_attention(q, k, v, impl: str = "xla",
+                   block_k: int | None = None):
     """Mesh-adaptive entry used by models: ring over the ambient mesh's
     `seq` axis when present (>1), else exact fallback (flash kernel when
     impl="flash", plain attention otherwise — so the same model code runs
@@ -157,9 +163,10 @@ def ring_attention(q, k, v, impl: str = "xla"):
             # flash_attention_sharded, not the bare kernel: a seq-less
             # mesh can still carry a model axis (ring_flash under TP),
             # and the bare pallas_call would silently replicate there.
-            return checkpoint_name(flash_attention_sharded(q, k, v),
-                                   "attn_out")
+            return checkpoint_name(
+                flash_attention_sharded(q, k, v, block_k=block_k),
+                "attn_out")
         from dist_mnist_tpu.ops.nn import dot_product_attention
 
         return dot_product_attention(q, k, v)
-    return ring_self_attention(q, k, v, mesh, impl=impl)
+    return ring_self_attention(q, k, v, mesh, impl=impl, block_k=block_k)
