@@ -143,6 +143,27 @@ CONFIGS = {
         mesh=MeshSpec(data=-1, seq=2),
         ladder_devices=16,
     ),
+    # 5g) Ulysses with the flash LOCAL engine: after the head reshard each
+    # device attends over the FULL sequence — the configuration where the
+    # kernel's VMEM score tiles matter most (parallel/ulysses.py).
+    "vit_tiny_cifar_ulysses_flash": Config(
+        name="vit_tiny_cifar_ulysses_flash",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"attention_impl": "ulysses_flash", "pool": "mean",
+                      "heads": 4, "scan_blocks": True},
+        mesh=MeshSpec(data=-1, seq=2),
+        ladder_devices=16,
+    ),
     # 5c) config 5 with switch-MoE FFN blocks, expert-parallel over a
     # 4-way `model` axis (one expert per rank — parallel/moe.py); the
     # load-balance aux loss joins the objective via model_state.

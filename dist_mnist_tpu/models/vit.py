@@ -6,9 +6,11 @@ Standard ViT-Ti geometry (dim 192, depth 12, heads 3), 4x4 patches so a
 pre-LN blocks. The attention inner loop is swappable: the default XLA
 einsum path (ops/nn.dot_product_attention), the Pallas flash kernel
 (ops/pallas/flash_attention.py), ring attention over the `seq` mesh axis
-(parallel/ring_attention.py), or Ulysses all-to-all sequence parallelism
-(parallel/ulysses.py; needs heads % seq == 0) — selected by
-`attention_impl`.
+(parallel/ring_attention.py, "ring_flash" = flash local blocks), or
+Ulysses all-to-all sequence parallelism (parallel/ulysses.py; needs
+heads % seq == 0; "ulysses_flash" = flash local full-S attention) —
+selected by `attention_impl`; `attention_block_k` streams K/V tiles
+within the kernel paths.
 """
 
 from __future__ import annotations
@@ -59,9 +61,10 @@ class ViTTiny:
     mlp_ratio: int = 4
     dropout_rate: float = 0.1
     compute_dtype: jnp.dtype = jnp.bfloat16
-    # "xla" | "flash" | "ring" | "ring_flash" | "ulysses"
+    # "xla" | "flash" | "ring" | "ring_flash" | "ulysses" | "ulysses_flash"
     attention_impl: str = "xla"
-    attention_block_k: int | None = None  # flash/ring_flash only: stream
+    attention_block_k: int | None = None  # kernel impls (flash,
+    # ring_flash, ulysses_flash): stream
     # K/V through VMEM in tiles of this many keys (online softmax,
     # ops/pallas/flash_attention block_k) instead of holding the full
     # (local) key axis resident. None = full-K (proven small-S path).
@@ -204,14 +207,22 @@ class ViTTiny:
                 impl="flash" if self.attention_impl == "ring_flash"
                 else "xla",
                 block_k=self.attention_block_k)
-        elif self.attention_impl == "ulysses":
+        elif self.attention_impl in ("ulysses", "ulysses_flash"):
             from dist_mnist_tpu.parallel.ulysses import ulysses_attention
 
-            out = ulysses_attention(q, k, v)
+            # ulysses_flash = all-to-all head reshard whose full-S LOCAL
+            # attention runs the Pallas kernel — the XLA path would
+            # materialize [B, H/n, S, S] in HBM (parallel/ulysses.py)
+            out = ulysses_attention(
+                q, k, v,
+                impl="flash" if self.attention_impl == "ulysses_flash"
+                else "xla",
+                block_k=self.attention_block_k)
         else:
             raise ValueError(
                 f"unknown attention_impl {self.attention_impl!r}; "
-                "use 'xla' | 'flash' | 'ring' | 'ring_flash' | 'ulysses'"
+                "use 'xla' | 'flash' | 'ring' | 'ring_flash' | 'ulysses' "
+                "| 'ulysses_flash'"
             )
         if self.attention_impl == "flash":
             # same save_attn remat tag the other impls get inside

@@ -25,6 +25,16 @@ from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
 from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
 
 
+def flash_attention_tagged(q, k, v, block_k=None):
+    """`flash_attention_sharded` + the `attn_out` remat tag — the shared
+    seq-less fallback for ring_flash and ulysses_flash (keeps the
+    save_attn policy surface uniform and in ONE place)."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(flash_attention_sharded(q, k, v, block_k=block_k),
+                           "attn_out")
+
+
 def flash_attention_sharded(q, k, v, block_k=None):
     """[B,S,H,D] flash attention on any ambient mesh.
 

@@ -153,19 +153,14 @@ def ring_attention(q, k, v, impl: str = "xla",
     mesh = get_abstract_mesh()
     if mesh is None or SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] == 1:
         if impl == "flash":
-            from jax.ad_checkpoint import checkpoint_name
+            from dist_mnist_tpu.parallel.flash import flash_attention_tagged
 
-            from dist_mnist_tpu.parallel.flash import flash_attention_sharded
-
-            # same attn_out tag ring_attention_inner applies on the
-            # sharded path (and dot_product_attention applies on the
-            # dense fallback) — keeps save_attn remat policy uniform.
-            # flash_attention_sharded, not the bare kernel: a seq-less
-            # mesh can still carry a model axis (ring_flash under TP),
-            # and the bare pallas_call would silently replicate there.
-            return checkpoint_name(
-                flash_attention_sharded(q, k, v, block_k=block_k),
-                "attn_out")
+            # the shared seq-less kernel fallback: mesh-adaptive (a
+            # seq-less mesh can still carry a model axis — ring_flash
+            # under TP — where a bare pallas_call would silently
+            # replicate) + the same attn_out tag every other attention
+            # path carries (save_attn remat policy stays uniform)
+            return flash_attention_tagged(q, k, v, block_k=block_k)
         from dist_mnist_tpu.ops.nn import dot_product_attention
 
         return dot_product_attention(q, k, v)

@@ -7,6 +7,13 @@ then runs fully local per device (exact, no streaming softmax needed), and a
 second all_to_all restores the sequence sharding. Cheaper than ring when
 H >= ring size and S_local is small; ring wins for very long S (its
 memory stays O(S_local)).
+
+`impl="flash"` swaps the local attention for the Pallas kernel — and
+matters MORE here than in ring: after the reshard each device attends over
+the FULL sequence, so the XLA path materializes a full [B, H/n, S, S]
+score tensor in HBM; the kernel keeps score tiles in VMEM (plus `block_k`
+streams K/V — ops/pallas/flash_attention). Mirrors ring_attention's
+impl/block_k surface; ViT selects it via attention_impl="ulysses_flash".
 """
 
 from __future__ import annotations
@@ -21,8 +28,15 @@ from dist_mnist_tpu.cluster.mesh import SEQ_AXIS
 from dist_mnist_tpu.ops.nn import dot_product_attention
 
 
-def ulysses_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
-    """Inside shard_map: [B, S_local, H, D] per device; H % axis_size == 0."""
+def ulysses_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
+                            impl: str = "xla",
+                            block_k: int | None = None):
+    """Inside shard_map: [B, S_local, H, D] per device; H % axis_size == 0.
+    `impl` picks the local full-S attention engine: "xla" (HBM score
+    tensor) or "flash" (VMEM score tiles; `block_k` streams K/V)."""
+    if impl not in ("xla", "flash"):
+        raise ValueError(
+            f"ulysses attention impl {impl!r}: use 'xla' | 'flash'")
     n = lax.axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(f"heads {q.shape[2]} not divisible by seq axis {n}")
@@ -31,16 +45,30 @@ def ulysses_attention_inner(q, k, v, axis_name: str = SEQ_AXIS):
                                        concat_axis=1, tiled=True)
     unshard = lambda x: lax.all_to_all(x, axis_name, split_axis=1,
                                        concat_axis=2, tiled=True)
-    out = dot_product_attention(reshard(q), reshard(k), reshard(v))
+    if impl == "flash":
+        from jax.ad_checkpoint import checkpoint_name
+
+        from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
+
+        # same attn_out tag dot_product_attention applies on the xla path
+        # (save_attn remat policy stays uniform across impls)
+        out = checkpoint_name(
+            flash_attention(reshard(q), reshard(k), reshard(v),
+                            block_k=block_k),
+            "attn_out")
+    else:
+        out = dot_product_attention(reshard(q), reshard(k), reshard(v))
     return unshard(out)
 
 
-def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
+def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
+                           impl: str = "xla", block_k: int | None = None):
     from dist_mnist_tpu.cluster.mesh import DATA_AXIS
 
     spec = P(DATA_AXIS, axis_name, None, None)
     fn = jax.shard_map(
-        partial(ulysses_attention_inner, axis_name=axis_name),
+        partial(ulysses_attention_inner, axis_name=axis_name, impl=impl,
+                block_k=block_k),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -49,12 +77,19 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS):
     return fn(q, k, v)
 
 
-def ulysses_attention(q, k, v):
+def ulysses_attention(q, k, v, impl: str = "xla",
+                      block_k: int | None = None):
     """Mesh-adaptive entry used by models (mirrors ring_attention): the
     all-to-all reshard runs over the ambient mesh's `seq` axis when present
-    (>1), else falls back to exact local attention — the same model code
-    runs on any mesh. Requires H % seq == 0 and S % seq == 0 on seq meshes."""
+    (>1), else falls back to the impl-matched exact path — the same model
+    code runs on any mesh AND keeps its kernel choice. Requires
+    H % seq == 0 and S % seq == 0 on seq meshes."""
     mesh = get_abstract_mesh()
     if mesh is None or SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] == 1:
+        if impl == "flash":
+            from dist_mnist_tpu.parallel.flash import flash_attention_tagged
+
+            # shared seq-less kernel fallback (see parallel/flash.py)
+            return flash_attention_tagged(q, k, v, block_k=block_k)
         return dot_product_attention(q, k, v)
-    return ulysses_self_attention(q, k, v, mesh)
+    return ulysses_self_attention(q, k, v, mesh, impl=impl, block_k=block_k)

@@ -48,6 +48,7 @@ run_stage bench_vit_moe  1800 python bench.py --config vit_tiny_cifar_moe --dead
 run_stage bench_vit_pp   1800 python bench.py --config vit_tiny_cifar_pp --deadline 1700
 run_stage bench_vit_flash 1800 python bench.py --config vit_tiny_cifar_flash --deadline 1700
 run_stage bench_vit_ring_flash 1800 python bench.py --config vit_tiny_cifar_ring_flash --deadline 1700
+run_stage bench_vit_uly_flash 1800 python bench.py --config vit_tiny_cifar_ulysses_flash --deadline 1700
 run_stage step_ablation  1800 python scripts/step_ablation.py
 run_stage vit_probe      3600 python scripts/vit_probe.py
 run_stage perf_sweep     1800 python scripts/perf_sweep.py

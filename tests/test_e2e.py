@@ -16,7 +16,7 @@ def test_config_registry_covers_ladder():
         "resnet20_cifar", "vit_tiny_cifar", "vit_tiny_cifar_ulysses",
         "vit_tiny_cifar_moe", "vit_tiny_cifar_pp", "vit_tiny_cifar_tp",
         "vit_tiny_cifar_ring", "vit_tiny_cifar_flash",
-        "vit_tiny_cifar_ring_flash",
+        "vit_tiny_cifar_ring_flash", "vit_tiny_cifar_ulysses_flash",
     }
     # every §2.6 strategy is CLI-selectable from the ladder: DP (all),
     # TP, SP-ring, SP-ulysses, EP-moe, PP — one config each

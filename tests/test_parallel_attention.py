@@ -772,3 +772,109 @@ def test_flash_sharded_tp_threads_block_k(mesh_tp):
             a, b, c, block_k=128))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=2e-4, atol=2e-5)
+
+
+class TestUlyssesFlash:
+    """Ulysses with the flash local engine (r5): after the head reshard
+    each device attends over the FULL sequence — where VMEM score tiles
+    matter most. Must equal the xla local engine and dense."""
+
+    def test_matches_xla_and_dense(self, mesh_seq):
+        # S=512: after the reshard each device attends over the FULL 512
+        # tokens, so block_k=128 genuinely streams 4 K tiles (S=32 would
+        # quantize block_k away to the single-tile full-K path)
+        q, k, v = _qkv(s=512, h=4, seed=31)
+        expected = dot_product_attention(q, k, v)
+        with mesh_seq:
+            out_fl = ulysses_self_attention(q, k, v, mesh_seq,
+                                            impl="flash")
+            out_bk = ulysses_self_attention(q, k, v, mesh_seq,
+                                            impl="flash", block_k=128)
+        np.testing.assert_allclose(np.asarray(out_fl),
+                                   np.asarray(expected),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(out_bk),
+                                   np.asarray(expected),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_grads_match_dense(self, mesh_seq):
+        q, k, v = _qkv(h=4, seed=32)
+
+        def g(fn):
+            return jax.grad(
+                lambda a, b, c: jnp.sum(fn(a, b, c) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+
+        g_ref = g(dot_product_attention)
+        with mesh_seq:
+            g_fl = g(jax.jit(lambda a, b, c: ulysses_self_attention(
+                a, b, c, mesh_seq, impl="flash")))
+        for r, got in zip(g_ref, g_fl):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(r),
+                                       rtol=3e-4, atol=3e-5)
+
+    def test_fallback_no_seq_mesh_keeps_kernel(self):
+        """Off any seq mesh, impl='flash' degrades to the (mesh-adaptive)
+        kernel, not the einsum — same contract as ring_flash."""
+        from dist_mnist_tpu.parallel.ulysses import ulysses_attention
+
+        q, k, v = _qkv(seed=33)
+        out = ulysses_attention(q, k, v, impl="flash")
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(dot_product_attention(q, k, v)),
+            rtol=2e-4, atol=2e-5)
+
+    def test_rejects_unknown_impl(self, mesh_seq):
+        q, k, v = _qkv(h=4, seed=34)
+        with pytest.raises(ValueError, match="impl"):
+            with mesh_seq:
+                ulysses_self_attention(q, k, v, mesh_seq, impl="cuda")
+
+    def test_through_vit_fwd_bwd(self):
+        """ulysses_flash selected FROM THE MODEL on a seq mesh: logits
+        and grads match the xla impl (same standard as ring_flash)."""
+        from dist_mnist_tpu.cluster.mesh import activate
+        from dist_mnist_tpu.models import get_model
+        from dist_mnist_tpu.ops.losses import softmax_cross_entropy
+
+        mesh = make_mesh(MeshSpec(data=2, seq=2))
+        kwargs = dict(depth=2, dim=64, heads=4, patch=8, pool="mean",
+                      compute_dtype=jnp.float32)
+        rng = np.random.default_rng(35)
+        x = jnp.asarray(rng.normal(size=(4, 32, 32, 3)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 10, (4,)), jnp.int32)
+        results = {}
+        for impl in ("xla", "ulysses_flash"):
+            model = get_model("vit_tiny", attention_impl=impl, **kwargs)
+            params, state = model.init(jax.random.PRNGKey(0), x)
+
+            def loss_fn(p):
+                logits, _ = model.apply(p, state, x, train=False)
+                return softmax_cross_entropy(logits, y), logits
+
+            with activate(mesh):
+                (loss, logits), grads = jax.jit(
+                    jax.value_and_grad(loss_fn, has_aux=True))(params)
+                jax.block_until_ready(loss)
+            results[impl] = (float(loss), np.asarray(logits), grads)
+        np.testing.assert_allclose(results["xla"][1],
+                                   results["ulysses_flash"][1],
+                                   rtol=2e-4, atol=2e-5)
+        for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(results["xla"][2])[0][:8],
+            jax.tree_util.tree_flatten_with_path(
+                results["ulysses_flash"][2])[0][:8],
+        ):
+            assert ka == kb
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5,
+                                       err_msg=str(ka))
+
+    def test_config_selectable(self):
+        from dist_mnist_tpu.configs import get_config
+        from dist_mnist_tpu.models import get_model
+
+        cfg = get_config("vit_tiny_cifar_ulysses_flash")
+        model = get_model(cfg.model, **cfg.model_kwargs)
+        assert model.attention_impl == "ulysses_flash"
+        assert model.heads % cfg.mesh.seq == 0
