@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Long-context attention engine comparison on ONE chip (SURVEY §5.7).
+
+Times fwd+bwd through each single-device attention engine at growing
+sequence lengths and prints one JSON line per (engine, S) point plus a
+summary — the measured basis for the long-context engine choice the docs
+currently argue from design (ring/ulysses cover the multi-device axis;
+this probe covers the single-device kernel axis they compose with):
+
+  xla        ops/nn.dot_product_attention — HBM [B,H,S,S] score tensor
+  flash      Pallas kernel, full K/V resident per q tile (block_k=None)
+  flash_bk   Pallas kernel, online-softmax streaming (block_k=512)
+
+Also records each engine's compile-time per-device temp memory
+(memory_analysis) so the HBM-score-tensor vs VMEM-tiles claim is a
+measured number, not prose. Geometry: B=4, H=8, D=64 (bf16) — a realistic
+long-context attention slice; S sweeps 1k..8k (the full-K kernel's
+documented ceiling) and the streaming path continues to 16k where only it
+can run without sequence sharding.
+
+CPU smoke: loss-parity across engines is the meaningful output (time
+ratios are interpreter artifacts — the Pallas interpreter is orders of
+magnitude slower than compiled XLA on CPU; ignore). On the real chip the
+time and memory columns are the result. Bounded probe first: on a dead
+relay this exits with a structured JSON error line instead of hanging
+(scripts/measure_all.sh stage discipline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--block_k", type=int, default=512)
+    ap.add_argument("--max_s", type=int, default=16384)
+    args = ap.parse_args()
+
+    from bench import probe_or_exit
+
+    probe_or_exit("longctx_probe")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from dist_mnist_tpu.ops.nn import dot_product_attention
+    from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    engines = {
+        "xla": dot_product_attention,
+        "flash": lambda q, k, v: flash_attention(q, k, v),
+        "flash_bk": lambda q, k, v: flash_attention(
+            q, k, v, block_k=args.block_k),
+    }
+    # the full-K kernel's documented resident ceiling; past it, only the
+    # streaming path runs single-device (the xla path's S x S score tensor
+    # has usually OOM'd HBM earlier at real batch sizes)
+    ceiling = {"xla": 8192, "flash": 8192, "flash_bk": args.max_s}
+
+    results = {}
+    s = 1024
+    while s <= args.max_s:
+        rng = np.random.default_rng(s)
+        mk = lambda: jnp.asarray(
+            rng.normal(size=(args.batch, s, args.heads, args.dim)), dtype)
+        q, k, v = mk(), mk(), mk()
+        for name, fn in engines.items():
+            if s > ceiling[name]:
+                continue
+            # grads w.r.t. ALL of q/k/v — dropping k/v would let DCE
+            # delete the dK/dV backward (flash's dkv kernels, xla's
+            # einsum grads) and bias the engine comparison (code review)
+            step = jax.jit(jax.value_and_grad(
+                lambda qq, kk, vv, f=fn: jnp.sum(
+                    f(qq, kk, vv).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2)))
+            try:
+                lowered = step.lower(q, k, v).compile()
+                mem = lowered.memory_analysis()
+                loss, g = lowered(q, k, v)  # compile already paid; warmup
+                float(jax.device_get(loss))
+                t0 = time.monotonic()
+                for _ in range(args.iters):
+                    loss, g = lowered(q, k, v)
+                final = float(jax.device_get(loss))
+                dt = (time.monotonic() - t0) / args.iters
+            except Exception as e:  # OOM/VMEM overflow is a RESULT here
+                print(json.dumps({
+                    "script": "longctx_probe", "engine": name, "s": s,
+                    "error": f"{type(e).__name__}: {str(e)[:160]}",
+                }), flush=True)
+                continue
+            results[(name, s)] = (dt, final)
+            print(json.dumps({
+                "script": "longctx_probe", "engine": name, "s": s,
+                "ms_fwd_bwd": round(dt * 1e3, 2),
+                "temp_mem_mb": round(mem.temp_size_in_bytes / 2**20, 1),
+                "loss_sanity": round(final, 4),
+            }), flush=True)
+        s *= 2
+
+    # parity check: at each S every engine that ran must agree on the loss
+    parity = {}
+    for (name, s), (_, loss) in results.items():
+        parity.setdefault(s, {})[name] = loss
+    mismatch = {
+        s: v for s, v in parity.items()
+        if max(v.values()) - min(v.values())
+        > 2e-2 * max(abs(x) for x in v.values())
+    }
+    print(json.dumps({
+        "script": "longctx_probe", "backend": jax.default_backend(),
+        "summary": {
+            f"{name}@{s}": round(dt * 1e3, 2)
+            for (name, s), (dt, _) in sorted(results.items(),
+                                             key=lambda kv: kv[0][1])
+        },
+        "loss_parity_ok": not mismatch,
+        "note": ("CPU: time ratios are interpreter artifacts; parity is "
+                 "the output of record" if jax.default_backend() == "cpu"
+                 else "device_get stop-clock; temp_mem from XLA "
+                      "memory_analysis"),
+    }), flush=True)
+    return 0 if not mismatch else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

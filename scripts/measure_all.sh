@@ -53,6 +53,7 @@ run_stage step_ablation  1800 python scripts/step_ablation.py
 run_stage vit_probe      3600 python scripts/vit_probe.py
 run_stage perf_sweep     1800 python scripts/perf_sweep.py
 run_stage pp_probe       1800 python scripts/pp_probe.py
+run_stage longctx_probe  1800 python scripts/longctx_probe.py
 
 echo "battery complete -> $OUT"
 grep -h '"metric"\|"variant"\|"summary"' "$OUT"/*.log | head -60

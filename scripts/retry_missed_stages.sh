@@ -34,12 +34,14 @@ for i in $(seq 1 "$ROUNDS"); do
     run_stage bench_vit_pp    1800 python bench.py --config vit_tiny_cifar_pp --deadline 1700
     run_stage bench_vit_flash 1800 python bench.py --config vit_tiny_cifar_flash --deadline 1700
     run_stage bench_vit_ring_flash 1800 python bench.py --config vit_tiny_cifar_ring_flash --deadline 1700
+    run_stage bench_vit_uly_flash 1800 python bench.py --config vit_tiny_cifar_ulysses_flash --deadline 1700
     run_stage step_ablation   1800 python scripts/step_ablation.py
     run_stage vit_probe       3600 python scripts/vit_probe.py
     run_stage perf_sweep      1800 python scripts/perf_sweep.py
     # needs >=8 chips; on this 1-chip box it records its structured
     # "cannot form mesh" line, completing the battery record honestly
     run_stage pp_probe        1800 python scripts/pp_probe.py
+    run_stage longctx_probe   1800 python scripts/longctx_probe.py
     echo "catch-up pass complete -> $OUT"
     grep -h '"metric"\|"variant"\|"summary"' "$OUT"/*.log | head -40
     exit 0
