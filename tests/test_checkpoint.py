@@ -270,3 +270,40 @@ def test_partial_metric_checkpoint_restores(tmp_path, mesh8):
     # the refilled entry carries the target's initial value
     assert float(restored.model_state["moe_ep_engaged_metric"]) == 0.0
     mgr.close()
+
+
+def test_flipped_layout_plus_partial_metrics_heals(tmp_path, mesh8):
+    """The deepest healing rung: a checkpoint saved in the UNROLLED block
+    layout with an OLDER metric set restores into a scanned-layout target
+    carrying a newer metric — exercising the 'flipped layout + on-disk
+    _metric entries only' rung added in r5."""
+    import dataclasses
+
+    opt = optim.adam(0.01)
+    sample = np.zeros((1, 32, 32, 3), np.uint8)
+    kw = dict(depth=2, dim=32, heads=4, patch=8, pool="mean",
+              compute_dtype=jnp.float32, mlp_impl="moe", n_experts=2)
+    unrolled = get_model("vit_tiny", scan_blocks=False, **kw)
+    scanned = get_model("vit_tiny", scan_blocks=True, **kw)
+    with mesh8:
+        st_unrolled = shard_train_state(
+            create_train_state(unrolled, opt, jax.random.PRNGKey(0),
+                               sample), mesh8)
+        st_scanned = shard_train_state(
+            create_train_state(scanned, opt, jax.random.PRNGKey(0),
+                               sample), mesh8)
+    old = dataclasses.replace(st_unrolled, model_state={
+        k: v for k, v in st_unrolled.model_state.items()
+        if k != "moe_ep_engaged_metric"})
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(old)
+    mgr.wait()
+    restored = mgr.restore(st_scanned)
+    assert sorted(restored.model_state) == sorted(st_scanned.model_state)
+    # layout actually converted: stacked blocks, matching init values
+    assert "blocks" in restored.params and "block0" not in restored.params
+    np.testing.assert_allclose(
+        np.asarray(restored.params["blocks"]["attn"]["qkv"]["w"][0]),
+        np.asarray(st_unrolled.params["block0"]["attn"]["qkv"]["w"]),
+        rtol=1e-6)
+    mgr.close()
