@@ -8,10 +8,12 @@
 #   bash scripts/retry_missed_stages.sh [outdir] [max_probe_rounds]
 
 set -u
-OUT="${1:-/tmp/measure_retry_$(date +%Y%m%d_%H%M%S)}"
+OUT="$(realpath -m "${1:-/tmp/measure_retry_$(date +%Y%m%d_%H%M%S)}")"
 ROUNDS="${2:-32}"
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
+# one pattern for every harvest/display site (drift risk otherwise)
+METRIC_RE='"metric"\|"variant"\|"summary"'
 
 run_stage() { # name timeout_s cmd...   (same shape as measure_all.sh)
   local name="$1" budget="$2"; shift 2
@@ -20,12 +22,21 @@ run_stage() { # name timeout_s cmd...   (same shape as measure_all.sh)
   local rc=$?
   tail -3 "$OUT/$name.log"
   echo "=== [$name] rc=$rc end $(date -u +%H:%M:%SZ) ==="
+  # land results in-repo IMMEDIATELY (not at pass end): a late-recovery
+  # pass interrupted by round end still leaves every finished stage's
+  # metric lines where the driver's final auto-commit captures them
+  grep -h "$METRIC_RE" "$OUT/$name.log" \
+    >> docs/measurements/r5_retry.jsonl 2>/dev/null || true
 }
 
 for i in $(seq 1 "$ROUNDS"); do
   if python scripts/probe_tpu.py --retries 1 --timeout 90 \
        >"$OUT/probe_$i.log" 2>&1; then
     echo "relay alive on probe $i — running missed stages"
+    # pass boundary in the evidence file: a re-launched pass appends its
+    # own delimited block instead of anonymous duplicate lines
+    echo "{\"retry_pass\": \"$(date -u +%FT%TZ)\", \"outdir\": \"$OUT\"}" \
+      >> docs/measurements/r5_retry.jsonl
     # first ViT-family stage pays the cold compile (docs/PERF.md ~25 min)
     run_stage bench_vit_tp    3200 python bench.py --config vit_tiny_cifar_tp --deadline 3000
     run_stage bench_vit_uly   1800 python bench.py --config vit_tiny_cifar_ulysses --deadline 1700
@@ -43,7 +54,7 @@ for i in $(seq 1 "$ROUNDS"); do
     run_stage pp_probe        1800 python scripts/pp_probe.py
     run_stage longctx_probe   1800 python scripts/longctx_probe.py
     echo "catch-up pass complete -> $OUT"
-    grep -h '"metric"\|"variant"\|"summary"' "$OUT"/*.log | head -40
+    grep -h "$METRIC_RE" "$OUT"/*.log | head -40
     exit 0
   fi
   echo "probe $i: relay down ($(date -u +%H:%M:%SZ)); sleeping 900s"
