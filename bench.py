@@ -347,6 +347,66 @@ def bench_config(name: str, n_timed: int) -> int:
     return 0
 
 
+def bench_serve(n_requests: int, concurrency: int) -> int:
+    """Online-serving latency: drive the inference server with the
+    deterministic closed-loop loadgen and report p99 request latency.
+
+    `vs_baseline` is 0.0 (latency has no seed anchor yet; the anchor file
+    machinery picks it up once a BENCH round records one). Weights are a
+    fresh deterministic init — serving latency does not depend on weight
+    VALUES, and bench must not require a training run to have happened."""
+    import jax
+
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.serve import (
+        InferenceEngine,
+        InferenceServer,
+        ServeConfig,
+        load_for_serving,
+        run_loadgen,
+    )
+
+    metric = "serve_p99_latency_ms"
+    mesh = make_mesh(MeshSpec(data=-1))
+    bundle = load_for_serving("mlp_mnist", mesh)
+    engine = InferenceEngine(
+        bundle.model, bundle.params, bundle.model_state, mesh,
+        model_name="mlp", image_shape=bundle.image_shape,
+        rules=bundle.rules, max_bucket=64,
+    )
+    server = InferenceServer(engine, ServeConfig(
+        max_batch=64, max_wait_ms=2.0, queue_depth=4 * concurrency,
+    ))
+    with server:
+        # warmup pass so compile/first-dispatch never lands in the timed run
+        run_loadgen(server, n_requests=concurrency,
+                    concurrency=concurrency,
+                    image_shape=bundle.image_shape, seed=1)
+        summary = run_loadgen(server, n_requests=n_requests,
+                              concurrency=concurrency,
+                              image_shape=bundle.image_shape, seed=0)
+    emit({
+        "metric": metric,
+        "value": round(summary["p99_ms"], 2),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "extra": {
+            "chips": jax.device_count(),
+            "p50_ms": round(summary["p50_ms"], 2),
+            "mean_ms": round(summary["mean_ms"], 2),
+            "n_requests": n_requests,
+            "concurrency": concurrency,
+            "ok": summary["ok"],
+            "rejected_queue_full": summary["rejected_queue_full"],
+            "mean_batch_size": round(summary["mean_batch_size"], 2),
+            "mean_occupancy": round(summary["mean_occupancy"], 3),
+            "cache": summary["cache"],
+            **_anchor_fields(metric, summary["p99_ms"]),
+        },
+    })
+    return 0
+
+
 def main() -> int:
     import jax
 
@@ -441,11 +501,19 @@ if __name__ == "__main__":
                          "accuracy race + throughput)")
     ap.add_argument("--steps", type=int, default=500,
                     help="timed steps in --config mode")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-latency mode: p99 request latency through "
+                         "the online inference server (serve_p99_latency_ms)")
+    ap.add_argument("--requests", type=int, default=512,
+                    help="loadgen request count in --serve mode")
+    ap.add_argument("--concurrency", type=int, default=64,
+                    help="loadgen in-flight window in --serve mode")
     ap.add_argument("--deadline", type=int, default=1500,
                     help="hard wall-clock bound; a structured JSON error "
                          "line is printed if exceeded")
     args = ap.parse_args()
-    metric = (f"{args.config}_steps_per_sec_per_chip" if args.config
+    metric = ("serve_p99_latency_ms" if args.serve
+              else f"{args.config}_steps_per_sec_per_chip" if args.config
               else HEADLINE_METRIC)
 
     install_deadline(metric, args.deadline)
@@ -462,7 +530,8 @@ if __name__ == "__main__":
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     try:
-        sys.exit(bench_config(args.config, args.steps) if args.config
+        sys.exit(bench_serve(args.requests, args.concurrency) if args.serve
+                 else bench_config(args.config, args.steps) if args.config
                  else main())
     except Exception as e:  # noqa: BLE001 — the contract is ONE JSON line, always
         emit_error(metric, f"{type(e).__name__}: {e}")

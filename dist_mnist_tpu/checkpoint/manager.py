@@ -115,24 +115,47 @@ def _flip_block_layouts(state, probe_only: bool = False):
     )
 
 
-def _is_structure_mismatch(err: Exception) -> bool:
-    """True when `err` is the pytree-structure-mismatch shape of failure
-    the healing ladder can possibly fix. Orbax raises these as ValueError
-    with a stable "…tree structures do not match" phrasing (measured:
-    "User-provided restore item and on-disk value metadata tree
-    structures do not match"); any KeyError counts (key lookups out of a
-    tree restore are structural; their str() carries no phrasing to
-    match). OSError (I/O), tensorstore read/checksum failures, etc. are
-    NOT healable and must propagate immediately."""
-    if isinstance(err, KeyError):
-        # str(KeyError('x')) is just "'x'" — no phrasing to match; a
-        # KeyError out of a tree restore is structural by nature
-        return True
-    if not isinstance(err, (ValueError, TypeError)):
-        return False
+def _tree_key_names(tree) -> set[str]:
+    """Every string dict key anywhere in `tree` (container keys, not
+    leaves) — the vocabulary a *structural* KeyError out of a restore of
+    this tree could possibly name."""
+    names: set[str] = set()
+
+    def rec(node):
+        if isinstance(node, dict) or hasattr(node, "keys"):
+            for k in node.keys():
+                if isinstance(k, str):
+                    names.add(k)
+                rec(node[k])
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                rec(v)
+
+    rec(tree)
+    return names
+
+
+def _path_names(tree) -> set[str]:
+    """Normalized "/"-joined key-path set of `tree`'s leaves — comparable
+    across a dataclass pytree (GetAttrKey) and a metadata dict (DictKey)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {
+        "/".join(str(getattr(k, "key", None) or getattr(k, "name", None)
+                     or k) for k in path)
+        for path, _ in flat
+    }
+
+
+def _phrasing_matches(err: Exception) -> bool:
+    """The fast path: Orbax's measured structure-mismatch wordings. Kept
+    only as a zero-I/O shortcut — classification no longer DEPENDS on
+    phrasing (ADVICE r5: an Orbax upgrade that rewords the ValueError
+    must not turn healable restores into hard failures); the metadata
+    probe in `CheckpointManager._is_healable` is the authority."""
     msg = str(err).lower()
     return ("tree structure" in msg or "structures do not match" in msg
-            or "user-provided restore item" in msg)
+            or "user-provided restore item" in msg
+            or "dict key mismatch" in msg)
 
 
 class CheckpointManager:
@@ -158,7 +181,17 @@ class CheckpointManager:
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
         )
-        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        try:
+            # declare the item handler up front: without it, a manager that
+            # has not saved/restored in THIS process cannot read tree
+            # metadata (`item_metadata` returns None) — which a fresh
+            # serving process needs for the weights-only restore below
+            self._mgr = ocp.CheckpointManager(
+                self.directory, options=options,
+                item_handlers=ocp.StandardCheckpointHandler(),
+            )
+        except TypeError:  # older orbax without item_handlers
+            self._mgr = ocp.CheckpointManager(self.directory, options=options)
         self._last_saved: int | None = None
 
     def latest_step(self, *, refresh: bool = False) -> int | None:
@@ -206,7 +239,7 @@ class CheckpointManager:
             # (advisor r4: transient I/O or data corruption used to burn
             # up to 3 more full restore attempts before the original
             # error re-raised)
-            if not _is_structure_mismatch(err):
+            if not self._is_healable(err, step, target_state):
                 raise
             restored = self._restore_with_structure_healing(
                 step, target_state, err
@@ -294,13 +327,67 @@ class CheckpointManager:
             )
         raise err
 
+    def _is_healable(self, err: Exception, step: int, target_state) -> bool:
+        """Should `err` (raised by a restore of `target_state`) enter the
+        structure-healing ladder?
+
+        Decided by exception TYPE plus evidence, never by wording alone
+        (ADVICE r5 — an Orbax upgrade rewording its errors must not turn
+        healable restores into hard failures):
+
+        - ``KeyError``: structural only when the missing key is an actual
+          tree key of the target (or of the on-disk metadata tree) — a
+          KeyError naming a key NEITHER tree contains came from somewhere
+          else (e.g. a bug in target construction) and must propagate, not
+          buy up to 5 extra full restore attempts.
+        - ``ValueError``/``TypeError``: the known phrasings short-circuit
+          (zero I/O); otherwise the on-disk tree metadata is probed
+          directly — a leaf-path set differing from the target's IS a
+          structure mismatch, whatever the message said.
+        - anything else (OSError, tensorstore read/checksum failures, …)
+          is not healable and propagates immediately.
+        """
+        if isinstance(err, KeyError):
+            key = err.args[0] if err.args else None
+            if not isinstance(key, str):
+                return False
+            names = _tree_key_names(
+                {"params": target_state.params,
+                 "model_state": target_state.model_state}
+            ) | {"params", "model_state", "opt_state", "step", "rng"}
+            if key in names:
+                return True
+            ondisk = self._ondisk_tree(step)
+            return ondisk is not None and key in _tree_key_names(ondisk)
+        if not isinstance(err, (ValueError, TypeError)):
+            return False
+        if _phrasing_matches(err):
+            return True
+        ondisk = self._ondisk_tree(step)
+        if ondisk is None:
+            return False  # no evidence either way: don't retry blindly
+        return _path_names(ondisk) != _path_names(target_state)
+
+    def _ondisk_tree(self, step: int):
+        """The checkpoint's metadata tree (no array reads), or None when
+        unreadable. Orbax >=0.6 wraps it in an object with a ``.tree``
+        attribute; older managers hand back the tree itself."""
+        try:
+            meta = self._mgr.item_metadata(step)
+            tree = getattr(meta, "tree", meta)
+            return tree if hasattr(tree, "keys") else None
+        except Exception:
+            return None
+
     def _ondisk_model_state_keys(self, step: int):
         """Top-level model_state key set of the checkpoint on disk (from
         Orbax tree metadata — no array reads), or None when metadata
         isn't readable; the healing ladder then falls back to the
         strip-everything rung."""
+        tree = self._ondisk_tree(step)
+        if tree is None:
+            return None
         try:
-            tree = self._mgr.item_metadata(step).tree
             ms = tree.get("model_state")
             return set(ms.keys()) if hasattr(ms, "keys") else None
         except Exception:
@@ -314,6 +401,47 @@ class CheckpointManager:
             target_state,
         )
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def restore_weights(self, abstract_params, abstract_model_state, *,
+                        step: int | None = None):
+        """Weights-only restore for inference (serve/loader.py): returns
+        ``(step, params, model_state)`` — or None when no checkpoint exists.
+
+        No optimizer is ever constructed: this orbax's StandardRestore
+        refuses a target missing top-level keys, so the non-weight entries
+        (opt_state, rng, step) get *metadata-derived* abstract leaves
+        (shape/dtype read from the checkpoint's own tree metadata, zero
+        optimizer code involved) and the restored slots are dropped on the
+        floor. For an Adam state that halves restore-target memory; more
+        importantly serving needs no optimizer import at all.
+
+        `abstract_params`/`abstract_model_state` are ShapeDtypeStruct trees
+        (shardings included) — build them with `jax.eval_shape` over
+        `model.init` so no throwaway init allocation happens either."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        tree = self._ondisk_tree(step)
+        if tree is None:
+            raise RuntimeError(
+                f"checkpoint step {step} in {self.directory} has no readable "
+                "tree metadata; cannot build a weights-only restore target"
+            )
+
+        def absify(meta):
+            return jax.ShapeDtypeStruct(tuple(meta.shape), meta.dtype)
+
+        abstract = {
+            k: jax.tree.map(absify, tree[k])
+            for k in tree.keys()
+            if k not in ("params", "model_state")
+        }
+        abstract["params"] = abstract_params
+        abstract["model_state"] = abstract_model_state
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+        return step, restored["params"], restored["model_state"]
 
     def restore_or_init(self, init_state):
         """≙ SessionManager.prepare_session (session_manager.py:259): try the

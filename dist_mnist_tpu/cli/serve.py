@@ -1,0 +1,112 @@
+"""Serving driver: load a checkpoint, start the inference server, drive it
+with the deterministic load generator, print a latency/batching summary.
+
+`python -m dist_mnist_tpu.cli.serve --config=mlp_mnist \
+    --checkpoint_dir=/tmp/ckpt --platform=cpu --host_device_count=8`
+
+There is deliberately no network listener here: the transport (gRPC/HTTP)
+is deployment-specific and trivial next to the hard parts — batching,
+compilation policy, admission — which this driver exercises end to end
+and docs/SERVING.md specifies. `InferenceServer.submit` IS the serving
+API; a transport shim maps one RPC to one submit().
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from absl import app, flags
+
+log = logging.getLogger(__name__)
+
+FLAGS = flags.FLAGS
+
+flags.DEFINE_string("config", "mlp_mnist", "config name (see configs.py)")
+flags.DEFINE_string("checkpoint_dir", None,
+                    "checkpoint directory to serve from (None = fresh init, "
+                    "with a warning — useful for latency benchmarking)")
+flags.DEFINE_integer("step", None, "checkpoint step (None = latest)")
+flags.DEFINE_string("logdir", None, "serve-metrics output directory")
+flags.DEFINE_string("mesh", None, 'mesh override, e.g. "data=8"')
+flags.DEFINE_string("platform", None, "pin the jax backend (e.g. cpu)")
+flags.DEFINE_integer("host_device_count", None,
+                     "with --platform=cpu: number of virtual host devices")
+# -- serving policy ----------------------------------------------------------
+flags.DEFINE_integer("max_batch", 64, "coalesce ceiling (requests per batch)")
+flags.DEFINE_float("max_wait_ms", 2.0, "coalesce window after first request")
+flags.DEFINE_integer("queue_depth", 256, "admission queue bound")
+flags.DEFINE_float("deadline_ms", 0, "per-request deadline; 0 = none")
+flags.DEFINE_boolean("prewarm", True, "compile all buckets before serving")
+# -- load generation ---------------------------------------------------------
+flags.DEFINE_integer("requests", 512, "loadgen request count")
+flags.DEFINE_integer("concurrency", 64, "loadgen in-flight window")
+flags.DEFINE_integer("seed", 0, "loadgen input seed")
+
+
+def main(argv):
+    del argv
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+    )
+    logging.getLogger("absl").setLevel(logging.WARNING)
+
+    from dist_mnist_tpu.cluster import initialize_distributed
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.obs.writers import make_default_writer
+    from dist_mnist_tpu.serve import (
+        InferenceEngine,
+        InferenceServer,
+        ServeConfig,
+        load_for_serving,
+        run_loadgen,
+    )
+
+    initialize_distributed(
+        None, 1, 0,
+        platform=FLAGS.platform, host_device_count=FLAGS.host_device_count,
+    )
+    cfg = get_config(FLAGS.config)
+    spec = cfg.mesh
+    if FLAGS.mesh:
+        kv = dict(part.split("=") for part in FLAGS.mesh.split(","))
+        spec = MeshSpec(**{k: int(v) for k, v in kv.items()})
+    mesh = make_mesh(spec)
+
+    bundle = load_for_serving(
+        cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step
+    )
+    engine = InferenceEngine(
+        bundle.model, bundle.params, bundle.model_state, mesh,
+        model_name=cfg.model, image_shape=bundle.image_shape,
+        rules=bundle.rules, max_bucket=max(FLAGS.max_batch, 1),
+    )
+    writer = make_default_writer(FLAGS.logdir)
+    server = InferenceServer(
+        engine,
+        ServeConfig(
+            max_batch=FLAGS.max_batch,
+            max_wait_ms=FLAGS.max_wait_ms,
+            queue_depth=FLAGS.queue_depth,
+            default_deadline_ms=FLAGS.deadline_ms or None,
+            prewarm=FLAGS.prewarm,
+        ),
+        writer=writer,
+    )
+    with server:
+        summary = run_loadgen(
+            server,
+            n_requests=FLAGS.requests,
+            concurrency=FLAGS.concurrency,
+            image_shape=bundle.image_shape,
+            seed=FLAGS.seed,
+        )
+    summary["checkpoint_step"] = bundle.step
+    summary["restored"] = bundle.restored
+    print(json.dumps(summary, indent=2, sort_keys=True))
+
+
+if __name__ == "__main__":
+    app.run(main)

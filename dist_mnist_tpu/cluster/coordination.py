@@ -64,8 +64,19 @@ def initialize_distributed(
             # N virtual host devices in THIS process (multi-device configs
             # on the CPU backend without the launcher, e.g.
             # `--platform=cpu --host_device_count=8`); must precede backend
-            # init. Only the cpu backend reads this setting.
-            jax.config.update("jax_num_cpu_devices", host_device_count)
+            # init. Only the cpu backend reads this setting. The config
+            # option only exists on jax>=0.5; older jax takes the same
+            # value through XLA_FLAGS (also read at backend init).
+            try:
+                jax.config.update("jax_num_cpu_devices", host_device_count)
+            except AttributeError:
+                import os
+
+                flag = (f"--xla_force_host_platform_device_count="
+                        f"{host_device_count}")
+                prev = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in prev:
+                    os.environ["XLA_FLAGS"] = f"{prev} {flag}".strip()
         else:
             log.warning(
                 "--host_device_count only applies to the cpu backend; "

@@ -256,10 +256,59 @@ def local_batch_slice(global_batch: int, mesh: Mesh) -> tuple[int, int]:
 def activate(mesh: Mesh):
     """Context manager installing `mesh` as the ambient mesh
     (`jax.set_mesh`): mesh-adaptive code (parallel/ring_attention.ring_
-    attention) discovers it via `jax.sharding.get_abstract_mesh()`, and raw
+    attention) discovers it via `ambient_mesh()` below, and raw
     PartitionSpecs become accepted wherever a sharding is expected. The
-    plain `with mesh:` context does NOT set the abstract mesh — use this."""
-    return jax.set_mesh(mesh)
+    plain `with mesh:` context does NOT set the abstract mesh on jax>=0.5
+    — use this. On older jax (no `jax.set_mesh`) the plain context IS the
+    discovery mechanism `ambient_mesh` falls back to, so this degrades to
+    it."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
+def ambient_mesh():
+    """The ambient (activated) mesh, or None.
+
+    jax>=0.5: `jax.sharding.get_abstract_mesh()`. Older jax: the `with
+    mesh:` context's physical mesh from the thread-local resource env —
+    the same thread-local `activate` degrades to there, so mesh-adaptive
+    modules (flash/moe/vit) discover the mesh identically on both."""
+    try:
+        from jax.sharding import get_abstract_mesh
+    except ImportError:  # jax<0.5
+        from jax._src.mesh import thread_resources
+
+        m = thread_resources.env.physical_mesh
+        return None if m.empty else m
+    return get_abstract_mesh()
+
+
+def compat_shard_map(f, *, mesh, in_specs, out_specs):
+    """`jax.shard_map` across jax versions: the public `jax.shard_map`
+    (jax>=0.6, `check_vma=`) when present, else the experimental one
+    (`check_rep=`). Both flags off: the mesh-adaptive callers close over
+    collectives whose replication jax cannot always infer."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
+def compat_axis_size(axis_name) -> int:
+    """Static mapped-axis size inside shard_map, across jax versions:
+    `lax.axis_size` (jax>=0.6) when present, else the axis-env frame
+    (which IS the size — an int — on jax 0.4/0.5). Static because callers
+    use it in shapes (per-device head counts, ring steps)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return getattr(frame, "size", frame)
 
 
 def validate_mesh(mesh: Mesh) -> None:

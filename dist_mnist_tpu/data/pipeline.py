@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+from dist_mnist_tpu.cluster.mesh import DATA_AXIS, compat_shard_map
 from dist_mnist_tpu.data.datasets import Dataset
 
 
@@ -215,11 +215,10 @@ class DeviceDataset:
             idx = jax.random.randint(k, (per_dev,), 0, images.shape[0])
             return jnp.take(images, idx, 0), jnp.take(labels, idx, 0)
 
-        img, lab = jax.shard_map(
+        img, lab = compat_shard_map(
             local_sample,
             mesh=self.mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-            check_vma=False,
         )(key, images, labels)
         return {"image": img.reshape(batch, *self.image_shape), "label": lab}

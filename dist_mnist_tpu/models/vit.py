@@ -268,11 +268,9 @@ class ViTTiny:
         plain scan (one model, any topology), loudly."""
         import logging
 
-        from jax.sharding import get_abstract_mesh
+        from dist_mnist_tpu.cluster.mesh import PIPE_AXIS, ambient_mesh
 
-        from dist_mnist_tpu.cluster.mesh import PIPE_AXIS
-
-        mesh = get_abstract_mesh()
+        mesh = ambient_mesh()
         shape = getattr(mesh, "shape", {}) if mesh is not None else {}
         axis = shape.get(PIPE_AXIS, 1)
         # axis > 1 required: a singleton/absent pipe axis always means the
@@ -299,12 +297,10 @@ class ViTTiny:
         microbatch, layer), so training is statistically equivalent to
         the scanned path's per-layer keys (the exact mask STREAM differs:
         the scanned path draws one full-batch mask per layer)."""
-        from jax.sharding import get_abstract_mesh
-
-        from dist_mnist_tpu.cluster.mesh import PIPE_AXIS
+        from dist_mnist_tpu.cluster.mesh import PIPE_AXIS, ambient_mesh
         from dist_mnist_tpu.parallel.pipeline import pipeline_apply
 
-        mesh = get_abstract_mesh()
+        mesh = ambient_mesh()
         n = mesh.shape[PIPE_AXIS]
         v = max(1, self.pipeline_circular)
         if not self.scan_blocks or self.depth % (n * v):

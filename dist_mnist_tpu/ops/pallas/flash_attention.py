@@ -48,6 +48,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed TPUCompilerParams -> CompilerParams in newer jax
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
                      s_real: int):
@@ -334,7 +338,7 @@ def _flash_fwd_impl(q, k, v, block_q: int, interpret: bool,
                 pltpu.VMEM((block_q,), jnp.float32),
                 pltpu.VMEM((block_q, d), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=_SEQ3),
             interpret=interpret,
         )(qb, kb, vb)
@@ -402,7 +406,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, block_q: int,
                       vec_spec_q, vec_spec_q],
             out_specs=mat_tile_q,
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=_SEQ3),
             interpret=interpret,
         )(qb, kb, vb, dob, lse, delta)
@@ -445,7 +449,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, dlse, block_q: int,
             out_specs=(mat_tile_k, mat_tile_k),
             scratch_shapes=[pltpu.VMEM((bk_tile, d), jnp.float32),
                             pltpu.VMEM((bk_tile, d), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=_SEQ3),
             interpret=interpret,
         )(kb, vb, qb, dob, lse, delta)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dist_mnist_tpu.cluster.mesh import compat_shard_map
 from dist_mnist_tpu.parallel.collectives import ring_shift
 
 
@@ -62,11 +63,10 @@ def allgather_matmul(x, w, mesh: Mesh, axis: str = "model"):
                 buf = ring_shift(buf, axis, reverse=True)
         return out
 
-    return jax.shard_map(
+    return compat_shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(None, axis)),
         out_specs=P(None, axis),
-        check_vma=False,
     )(x, w)
 
 
@@ -107,9 +107,8 @@ def matmul_reducescatter(x, w, mesh: Mesh, axis: str = "model"):
             acc = acc + chunk_dot((i + 1 + s) % n)
         return acc
 
-    return jax.shard_map(
+    return compat_shard_map(
         body, mesh=mesh,
         in_specs=(P(None, axis), P(axis, None)),
         out_specs=P(axis, None),
-        check_vma=False,
     )(x, w)

@@ -12,6 +12,8 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+from dist_mnist_tpu.cluster.mesh import compat_axis_size
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -24,14 +26,14 @@ def psum_mean(tree, axis_name: str = DATA_AXIS):
     replaces the whole PS push/pull + ConditionalAccumulator.take_grad
     average (sync_replicas_optimizer.py:295-300): one ICI all-reduce,
     in-program, overlapped by XLA with surrounding compute."""
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     return jax.tree.map(lambda g: lax.psum(g, axis_name) / n, tree)
 
 
 def ring_shift(x, axis_name: str, *, reverse: bool = False):
     """Rotate x one step around the axis ring via ppermute (the building
     block of ring attention / ring all-reduce; rides neighbour ICI links)."""
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     if reverse:
         perm = [(i, (i - 1) % n) for i in range(n)]
     else:
@@ -113,11 +115,12 @@ def make_explicit_dp_step(model, optimizer, mesh: Mesh, *, loss_fn=None):
     state_spec = P()  # replicated
     batch_spec = {"image": P(DATA_AXIS), "label": P(DATA_AXIS)}
 
-    sharded = jax.shard_map(
+    from dist_mnist_tpu.cluster.mesh import compat_shard_map
+
+    sharded = compat_shard_map(
         per_device_step,
         mesh=mesh,
         in_specs=(state_spec, batch_spec),
         out_specs=(state_spec, P()),
-        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))

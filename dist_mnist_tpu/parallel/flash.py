@@ -17,12 +17,19 @@ closed at every dispatch point, not special-cased in one model.
 from __future__ import annotations
 
 import functools
+import logging
 
-import jax
-from jax.sharding import PartitionSpec as P, get_abstract_mesh
+from jax.sharding import PartitionSpec as P
 
-from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
+from dist_mnist_tpu.cluster.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    ambient_mesh,
+    compat_shard_map,
+)
 from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
+
+log = logging.getLogger(__name__)
 
 
 def flash_attention_tagged(q, k, v, block_k=None):
@@ -44,7 +51,7 @@ def flash_attention_sharded(q, k, v, block_k=None):
     `block_k` selects the online-softmax streaming kernels (see
     flash_attention).
     """
-    mesh = get_abstract_mesh()
+    mesh = ambient_mesh()
     shape = getattr(mesh, "shape", {}) if mesh is not None else {}
     m = shape.get(MODEL_AXIS, 1)
     if m <= 1:
@@ -60,11 +67,23 @@ def flash_attention_sharded(q, k, v, block_k=None):
         )
     # batch rides the data axis only when it divides (an eval batch or a
     # bare call may not) — an unmentioned axis just means the kernel sees
-    # the full batch replicated, never an error
+    # the full batch replicated, never an error. But it IS an O(data)x
+    # compute/memory cliff: every device recomputes the whole batch, so say
+    # so once per trace (mirroring moe.py's dense-fallback warning — a
+    # jit-cached fallback is otherwise invisible; ADVICE r5)
     data = shape.get(DATA_AXIS, 1)
-    spec = P(DATA_AXIS if data > 1 and q.shape[0] % data == 0 else None,
+    batch_rides_data = data > 1 and q.shape[0] % data == 0
+    if data > 1 and not batch_rides_data:
+        log.warning(
+            "flash attention: batch=%d %% data axis %d != 0 — the kernel "
+            "drops the data axis and every device recomputes the FULL "
+            "replicated batch (%dx redundant compute/memory); use a batch "
+            "divisible by %d to ride the data axis",
+            q.shape[0], data, data, data,
+        )
+    spec = P(DATA_AXIS if batch_rides_data else None,
              None, MODEL_AXIS, None)
-    fn = jax.shard_map(
+    fn = compat_shard_map(
         functools.partial(flash_attention, block_k=block_k),
-        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False)
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
     return fn(q, k, v)

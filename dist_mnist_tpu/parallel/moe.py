@@ -45,11 +45,18 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+from dist_mnist_tpu.cluster.mesh import compat_axis_size
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P, get_abstract_mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
-from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
+from dist_mnist_tpu.cluster.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    ambient_mesh as get_abstract_mesh,
+    compat_shard_map,
+)
 from dist_mnist_tpu.ops.nn import fan_in_trunc_normal
 
 
@@ -156,7 +163,7 @@ def moe_ffn_inner(params, x, axis_name: str = MODEL_AXIS,
     is the exact global drop fraction, and per-expert load averaged over
     the per-shard queues (each shard routes its own T_local tokens with
     capacity C — the EP capacity is per-shard by construction)."""
-    n_experts = lax.axis_size(axis_name)
+    n_experts = compat_axis_size(axis_name)
     t, _ = x.shape
     capacity = max(1, int(-(-t // n_experts) * top_k * capacity_factor))
     dispatch, combine, f, p, stats = _route(params["gate"], x, n_experts,
@@ -236,7 +243,7 @@ def moe_ffn(params, x, mesh: Mesh, axis_name: str = MODEL_AXIS,
         "w2": P(axis_name), "b2": P(axis_name),
     }
     tok_spec = P((DATA_AXIS, axis_name))
-    run = jax.shard_map(
+    run = compat_shard_map(
         partial(moe_ffn_inner, axis_name=axis_name,
                 capacity_factor=capacity_factor,
                 aux_axes=(DATA_AXIS, axis_name), top_k=top_k),
@@ -244,6 +251,5 @@ def moe_ffn(params, x, mesh: Mesh, axis_name: str = MODEL_AXIS,
         in_specs=(p_spec, tok_spec),
         out_specs=(tok_spec, P(),
                    {"drop_fraction": P(), "expert_load": P()}),
-        check_vma=False,
     )
     return run(params, x)

@@ -50,6 +50,8 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+from dist_mnist_tpu.cluster.mesh import compat_axis_size
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -101,7 +103,7 @@ def pipeline_apply_inner(fn, stage_params, x_mb, rng=None,
     if rng is not None and fold_data_axis:
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
     s = lax.axis_index(axis_name)
-    n_stages = lax.axis_size(axis_name)
+    n_stages = compat_axis_size(axis_name)
     n_mb = x_mb.shape[0]
     first = jnp.equal(s, 0)
     last = jnp.equal(s, n_stages - 1)
@@ -183,7 +185,7 @@ def pipeline_apply_circular_inner(fn, chunk_params, x_mb, rng=None,
     if rng is not None and fold_data_axis:
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
     s = lax.axis_index(axis_name)
-    n_stages = lax.axis_size(axis_name)
+    n_stages = compat_axis_size(axis_name)
     v = n_chunks
     n_mb = x_mb.shape[0]
     first = jnp.equal(s, 0)
@@ -301,12 +303,13 @@ def pipeline_apply(fn, stacked_params, x, num_microbatches: int,
     # microbatch dim unsharded, per-microbatch batch dim over `data`
     x_spec = P(None, DATA_AXIS)
     in_specs = (p_spec, x_spec) + ((P(),) if rng is not None else ())
-    run = jax.shard_map(
+    from dist_mnist_tpu.cluster.mesh import compat_shard_map
+
+    run = compat_shard_map(
         inner,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=x_spec,
-        check_vma=False,
     )
     args = (stacked_params, x_mb) + ((rng,) if rng is not None else ())
     out = run(*args)

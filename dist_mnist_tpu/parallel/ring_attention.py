@@ -28,9 +28,13 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+from dist_mnist_tpu.cluster.mesh import compat_axis_size
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P, get_abstract_mesh
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import ambient_mesh as get_abstract_mesh
 
 from dist_mnist_tpu.cluster.mesh import SEQ_AXIS
 from dist_mnist_tpu.parallel.collectives import ring_shift
@@ -61,7 +65,7 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
     if impl not in ("xla", "flash"):
         raise ValueError(
             f"ring attention impl {impl!r}: use 'xla' | 'flash'")
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     scale = q.shape[-1] ** -0.5
     qf = q.astype(jnp.float32)
 
@@ -132,13 +136,14 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
     from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
 
     spec = P(DATA_AXIS, axis_name, MODEL_AXIS, None)
-    fn = jax.shard_map(
+    from dist_mnist_tpu.cluster.mesh import compat_shard_map
+
+    fn = compat_shard_map(
         partial(ring_attention_inner, axis_name=axis_name, impl=impl,
                 block_k=block_k),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     return fn(q, k, v)
 

@@ -74,7 +74,9 @@ def resolve_rules(name: str) -> ShardingRules:
 
 
 def _paths(tree):
-    flat, treedef = jax.tree.flatten_with_path(tree)
+    # tree_util spelling: `jax.tree.flatten_with_path` only exists on
+    # jax>=0.5, and this is the same function there
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     paths = ["/".join(str(getattr(k, "key", k)) for k in path) for path, _ in flat]
     return flat, treedef, paths
 

@@ -21,8 +21,12 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+from dist_mnist_tpu.cluster.mesh import compat_axis_size
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P, get_abstract_mesh
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import ambient_mesh as get_abstract_mesh
 
 from dist_mnist_tpu.cluster.mesh import SEQ_AXIS
 from dist_mnist_tpu.ops.nn import dot_product_attention
@@ -37,7 +41,7 @@ def ulysses_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
     if impl not in ("xla", "flash"):
         raise ValueError(
             f"ulysses attention impl {impl!r}: use 'xla' | 'flash'")
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     if q.shape[2] % n:
         raise ValueError(f"heads {q.shape[2]} not divisible by seq axis {n}")
     # scatter heads (axis 2), gather sequence (axis 1): -> [B, S, H/n, D]
@@ -66,13 +70,14 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = SEQ_AXIS,
     from dist_mnist_tpu.cluster.mesh import DATA_AXIS
 
     spec = P(DATA_AXIS, axis_name, None, None)
-    fn = jax.shard_map(
+    from dist_mnist_tpu.cluster.mesh import compat_shard_map
+
+    fn = compat_shard_map(
         partial(ulysses_attention_inner, axis_name=axis_name, impl=impl,
                 block_k=block_k),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     return fn(q, k, v)
 

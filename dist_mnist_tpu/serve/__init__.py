@@ -1,0 +1,45 @@
+"""Online inference serving: continuous dynamic batching over the data-axis
+mesh, an AOT compiled-model cache, and admission control.
+
+The training side of this repo answers "how fast can the mesh learn"; this
+package answers "how fast can the trained model answer", reusing the same
+building blocks — `parallel/sharding.py` placement, `cluster/mesh.py`
+meshes, `checkpoint/manager.py` weights, `obs/` metric writers — so a model
+serves exactly where it trained. docs/SERVING.md is the architecture note.
+
+Layering (each module depends only on those above it):
+
+    metrics.py    counters + latency/occupancy reservoirs -> obs writers
+    engine.py     CompiledModelCache + InferenceEngine (bucketing, AOT)
+    admission.py  bounded queue, deadlines, explicit rejection
+    batcher.py    the coalescing loop (one daemon thread)
+    loader.py     checkpoint -> (model, params, model_state), no optimizer
+    server.py     InferenceServer facade wiring all of the above
+    loadgen.py    deterministic closed-loop load generator (bench + tests)
+"""
+
+from dist_mnist_tpu.serve.admission import (
+    AdmissionQueue,
+    DeadlineExceededError,
+    QueueFullError,
+    ShuttingDownError,
+)
+from dist_mnist_tpu.serve.engine import CompiledModelCache, InferenceEngine
+from dist_mnist_tpu.serve.loader import load_for_serving
+from dist_mnist_tpu.serve.loadgen import run_loadgen
+from dist_mnist_tpu.serve.metrics import ServeMetrics
+from dist_mnist_tpu.serve.server import InferenceServer, ServeConfig
+
+__all__ = [
+    "AdmissionQueue",
+    "CompiledModelCache",
+    "DeadlineExceededError",
+    "InferenceEngine",
+    "InferenceServer",
+    "QueueFullError",
+    "ServeConfig",
+    "ServeMetrics",
+    "ShuttingDownError",
+    "load_for_serving",
+    "run_loadgen",
+]

@@ -1,0 +1,199 @@
+"""The inference engine: power-of-two bucketing + an AOT compiled-model
+cache over the data-axis mesh.
+
+Why buckets: a continuous batcher produces a *different* batch size every
+tick; jitting on the raw size would recompile on nearly every request
+pattern. Rounding up to a power of two caps the number of distinct
+executables at log2(max_batch) while wasting at most 2x compute on padding
+— and padding rows are pure throughput cost, never a correctness one
+(logits for pad rows are sliced off before completion).
+
+Why AOT (`jit(...).lower(...).compile()`): the cache makes compilation an
+*explicit, observable* event — hit/miss counters and compile-time
+attribution (utils/timing.stopclock) instead of jit's invisible internal
+cache, and `prewarm()` can move every expected compile to startup where it
+cannot poke a p99 latency hole in live traffic.
+
+The batch rides the `data` axis exactly as in training (`P(DATA_AXIS)`,
+the same spec data/pipeline.py uses), so a bucket of B runs B/data rows
+per device; params/model_state are placed once at engine construction by
+the same `parallel/sharding.py` rules the model trained under.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+from dist_mnist_tpu.parallel.sharding import DP_RULES, ShardingRules, tree_sharding
+from dist_mnist_tpu.utils.timing import stopclock
+
+log = logging.getLogger(__name__)
+
+
+class CompiledModelCache:
+    """key -> AOT-compiled executable, with hit/miss counters and compile
+    wall-time attribution. Keys are `(model_name, input_shape, mesh_key,
+    dtype)` — everything that changes the compiled program."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache: dict = {}
+        self.hits = 0
+        self.misses = 0
+        self.times: dict = {}  # stopclock accumulator: compile/execute secs
+
+    def get(self, key, build):
+        """The executable for `key`, compiling via `build()` on miss.
+        Compilation runs under the lock: concurrent misses for the same
+        bucket must not compile twice."""
+        with self._lock:
+            hit = key in self._cache
+            if hit:
+                self.hits += 1
+                return self._cache[key]
+            self.misses += 1
+            with stopclock(self.times, "compile"):
+                exe = build()
+            self._cache[key] = exe
+            log.info("compiled %s (miss #%d, %.0f ms)", key, self.misses,
+                     self.times["compile"] * 1e3 / self.times["compile_count"])
+            return exe
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "entries": len(self._cache),
+                "compile_secs": self.times.get("compile", 0.0),
+                "execute_secs": self.times.get("execute", 0.0),
+                "execute_count": self.times.get("execute_count", 0),
+            }
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+
+
+class InferenceEngine:
+    """Stateless-forward inference over a fixed (model, weights, mesh).
+
+    `predict(images)` takes a host batch of raw uint8 images `[n, H, W, C]`
+    and returns logits `[n, classes]` — padding, placement, compilation
+    caching and unpadding are internal. Normalization matches
+    train/step.py's eval step exactly (`x/255`), so serving a checkpoint
+    reproduces its eval accuracy bit-for-bit per row.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        model_state,
+        mesh: Mesh,
+        *,
+        model_name: str = "model",
+        image_shape: tuple[int, ...],
+        rules: ShardingRules = DP_RULES,
+        max_bucket: int = 256,
+    ):
+        self.model = model
+        self.mesh = mesh
+        self.model_name = model_name
+        self.image_shape = tuple(image_shape)
+        self.cache = CompiledModelCache()
+        # buckets must divide over the data axis; the smallest power of two
+        # >= the axis size always does (the axis size is itself a device
+        # count, i.e. a power of two on every supported topology)
+        self._data = mesh.shape[DATA_AXIS]
+        self.min_bucket = _pow2_at_least(self._data)
+        # a ceiling below the data-axis floor would leave NO legal bucket
+        self.max_bucket = max(max_bucket, self.min_bucket)
+        self._batch_shd = NamedSharding(mesh, P(DATA_AXIS))
+        self._param_shd = tree_sharding(params, mesh, rules)
+        self._ms_shd = tree_sharding(model_state, mesh, rules)
+        self.params = jax.device_put(params, self._param_shd)
+        self.model_state = jax.device_put(model_state, self._ms_shd)
+
+    # -- bucketing -----------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        if n < 1:
+            raise ValueError("empty batch")
+        b = max(_pow2_at_least(n), self.min_bucket)
+        if b > self.max_bucket:
+            raise ValueError(
+                f"batch {n} needs bucket {b} > max_bucket {self.max_bucket}; "
+                "raise max_bucket or split the batch upstream"
+            )
+        return b
+
+    def buckets(self) -> list[int]:
+        """Every bucket size this engine can execute, smallest first."""
+        out, b = [], self.min_bucket
+        while b <= self.max_bucket:
+            out.append(b)
+            b *= 2
+        return out
+
+    # -- compilation ---------------------------------------------------------
+    def _key(self, bucket: int):
+        mesh_key = tuple(sorted(self.mesh.shape.items()))
+        return (self.model_name, (bucket, *self.image_shape), mesh_key,
+                "uint8->float32")
+
+    def _compile(self, bucket: int):
+        def fwd(params, model_state, x):
+            x = x.astype(jnp.float32) / 255.0
+            logits, _ = self.model.apply(params, model_state, x, train=False)
+            return logits
+
+        jitted = jax.jit(
+            fwd,
+            in_shardings=(self._param_shd, self._ms_shd, self._batch_shd),
+            out_shardings=self._batch_shd,
+        )
+        abstract_x = jax.ShapeDtypeStruct(
+            (bucket, *self.image_shape), jnp.uint8, sharding=self._batch_shd
+        )
+        return jitted.lower(self.params, self.model_state, abstract_x).compile()
+
+    def compiled_for(self, bucket: int):
+        return self.cache.get(self._key(bucket), lambda: self._compile(bucket))
+
+    def prewarm(self, buckets: list[int] | None = None) -> int:
+        """Compile the expected buckets up front (all of them by default) so
+        live traffic never waits on XLA. Returns the number compiled."""
+        n0 = self.cache.misses
+        for b in buckets if buckets is not None else self.buckets():
+            self.compiled_for(self.bucket_for(b))
+        return self.cache.misses - n0
+
+    # -- execution -----------------------------------------------------------
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """Logits for `images` [n, *image_shape]; pads to the bucket, runs
+        the cached executable, unpads. The executed-batch clock stops on the
+        device_get of the logits (utils/timing.py discipline)."""
+        images = np.asarray(images)
+        if images.shape[1:] != self.image_shape:
+            raise ValueError(
+                f"image shape {images.shape[1:]} != engine's {self.image_shape}"
+            )
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        exe = self.compiled_for(bucket)
+        if n < bucket:
+            pad = np.zeros((bucket - n, *self.image_shape), dtype=np.uint8)
+            images = np.concatenate([images.astype(np.uint8), pad])
+        x = jax.device_put(images.astype(np.uint8), self._batch_shd)
+        with stopclock(self.cache.times, "execute"):
+            logits = np.asarray(
+                jax.device_get(exe(self.params, self.model_state, x))
+            )
+        return logits[:n]

@@ -1,0 +1,116 @@
+"""Checkpoint -> servable model, without ever constructing an optimizer.
+
+Training restore (`CheckpointManager.restore`) targets a full TrainState —
+params AND Adam slots AND the loop rng. Serving needs exactly the weights,
+so the loader builds *abstract* param/model-state targets with
+`jax.eval_shape` over `model.init` (zero throwaway device allocation),
+attaches the same `parallel/sharding.py` placement the model trained
+under, and calls the manager's weights-only restore
+(`restore_weights`): optimizer slots restore into metadata-derived
+abstract leaves and are discarded — `optim/` is never imported here.
+
+Falls back to a fresh deterministic init (same split discipline as
+`train.state.create_train_state`, so an untrained served model equals an
+untrained trained model bit-for-bit) when the directory holds no
+checkpoint — the loadgen/bench path needs no training run to exist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dist_mnist_tpu.configs import Config, get_config
+from dist_mnist_tpu.data.datasets import DATASETS
+from dist_mnist_tpu.models.registry import get_model
+from dist_mnist_tpu.parallel.sharding import (
+    ShardingRules,
+    resolve_rules,
+    tree_sharding,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ServingBundle:
+    model: Any
+    params: Any
+    model_state: Any
+    image_shape: tuple[int, ...]
+    num_classes: int
+    rules: ShardingRules
+    step: int  # train step the weights came from; 0 on fresh init
+    restored: bool
+
+
+def load_for_serving(
+    cfg: Config | str,
+    mesh: Mesh,
+    *,
+    checkpoint_dir: str | Path | None = None,
+    step: int | None = None,
+) -> ServingBundle:
+    """Build everything `InferenceEngine` needs from a config (+ optional
+    checkpoint directory). `cfg` may be a config name or a Config."""
+    if isinstance(cfg, str):
+        cfg = get_config(cfg)
+    model = get_model(cfg.model, **cfg.model_kwargs)
+    rules = resolve_rules(cfg.sharding_rules)
+    info = DATASETS[cfg.dataset]
+    image_shape = tuple(info["image_shape"])
+    sample = jnp.zeros((1, *image_shape), jnp.float32)
+    # same split as create_train_state: key0 inits, key1 runs the loop
+    init_key, _ = jax.random.split(jax.random.PRNGKey(cfg.seed))
+
+    restored = None
+    if checkpoint_dir is not None and Path(checkpoint_dir).exists():
+        from dist_mnist_tpu.checkpoint.manager import CheckpointManager
+
+        mgr = CheckpointManager(checkpoint_dir, async_save=False)
+        try:
+            abs_params, abs_ms = jax.eval_shape(model.init, init_key, sample)
+            abs_params = _with_shardings(abs_params, mesh, rules)
+            abs_ms = _with_shardings(abs_ms, mesh, rules)
+            restored = mgr.restore_weights(abs_params, abs_ms, step=step)
+        finally:
+            mgr.close()
+
+    if restored is not None:
+        ckpt_step, params, model_state = restored
+        log.info("serving weights from step %d of %s", ckpt_step,
+                 checkpoint_dir)
+    else:
+        if checkpoint_dir is not None:
+            log.warning("no checkpoint under %s; serving a FRESH init",
+                        checkpoint_dir)
+        ckpt_step = 0
+        params, model_state = model.init(init_key, sample)
+        params = jax.device_put(params, tree_sharding(params, mesh, rules))
+        model_state = jax.device_put(
+            model_state, tree_sharding(model_state, mesh, rules)
+        )
+    return ServingBundle(
+        model=model,
+        params=params,
+        model_state=model_state,
+        image_shape=image_shape,
+        num_classes=int(info["num_classes"]),
+        rules=rules,
+        step=ckpt_step,
+        restored=restored is not None,
+    )
+
+
+def _with_shardings(abstract_tree, mesh, rules):
+    shd = tree_sharding(abstract_tree, mesh, rules)
+    return jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract_tree, shd,
+    )

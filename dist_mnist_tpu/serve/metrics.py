@@ -1,0 +1,131 @@
+"""Serve-side metrics: latency percentiles, batch occupancy, queue depth,
+admission counters.
+
+Host-side and lock-guarded (the batcher thread and every client thread
+record concurrently); nothing here touches a device. Emission goes through
+the existing `obs.writers.MetricWriter` protocol so serve metrics land in
+the same CSV/TensorBoard sinks as training metrics.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+# bounded reservoirs: a long-lived server must not grow memory with request
+# count. 65536 most-recent samples bounds the p99 estimate error well below
+# anything a BENCH round can resolve.
+_RESERVOIR = 65536
+
+
+class ServeMetrics:
+    """Thread-safe accumulator for one server's lifetime.
+
+    Counters:  admitted, completed, rejected_queue_full, rejected_deadline,
+               rejected_shutdown, failed.
+    Reservoirs: request latency (ms, submit->result), executed batch sizes
+               (real rows), bucket occupancy (real rows / padded bucket).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.admitted = 0
+        self.completed = 0
+        self.rejected_queue_full = 0
+        self.rejected_deadline = 0
+        self.rejected_shutdown = 0
+        self.failed = 0
+        self._latency_ms = collections.deque(maxlen=_RESERVOIR)
+        self._batch_sizes = collections.deque(maxlen=_RESERVOIR)
+        self._occupancy = collections.deque(maxlen=_RESERVOIR)
+
+    def record_admitted(self):
+        with self._lock:
+            self.admitted += 1
+
+    def record_rejected(self, reason: str):
+        with self._lock:
+            if reason == "queue_full":
+                self.rejected_queue_full += 1
+            elif reason == "deadline":
+                self.rejected_deadline += 1
+            elif reason == "shutdown":
+                self.rejected_shutdown += 1
+            else:
+                raise ValueError(f"unknown rejection reason {reason!r}")
+
+    def record_failed(self, n: int = 1):
+        with self._lock:
+            self.failed += n
+
+    def record_batch(self, n_real: int, bucket: int):
+        """One executed batch: `n_real` genuine requests padded to `bucket`."""
+        with self._lock:
+            self._batch_sizes.append(n_real)
+            self._occupancy.append(n_real / bucket)
+
+    def record_latency(self, ms: float, n: int = 1):
+        with self._lock:
+            self._latency_ms.append(ms)
+            self.completed += n
+
+    def latency_percentiles(self) -> dict[str, float]:
+        with self._lock:
+            lat = np.asarray(self._latency_ms, dtype=np.float64)
+        if lat.size == 0:
+            return {"p50_ms": float("nan"), "p99_ms": float("nan"),
+                    "mean_ms": float("nan")}
+        return {
+            "p50_ms": float(np.percentile(lat, 50)),
+            "p99_ms": float(np.percentile(lat, 99)),
+            "mean_ms": float(lat.mean()),
+        }
+
+    def snapshot(self) -> dict:
+        """Point-in-time summary (plain floats/ints — JSON-safe for bench)."""
+        pct = self.latency_percentiles()
+        with self._lock:
+            sizes = np.asarray(self._batch_sizes, dtype=np.float64)
+            occ = np.asarray(self._occupancy, dtype=np.float64)
+            out = {
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_deadline": self.rejected_deadline,
+                "rejected_shutdown": self.rejected_shutdown,
+                "failed": self.failed,
+                "n_batches": int(sizes.size),
+            }
+        out.update(pct)
+        out["mean_batch_size"] = float(sizes.mean()) if sizes.size else 0.0
+        out["mean_occupancy"] = float(occ.mean()) if occ.size else 0.0
+        return out
+
+    def emit(self, writer, step: int, *, queue_depth: int | None = None,
+             cache: dict | None = None) -> None:
+        """Write the snapshot through an obs MetricWriter. `serve/` prefix
+        keeps the tags clear of training scalars in a shared logdir."""
+        snap = self.snapshot()
+        for tag in ("p50_ms", "p99_ms", "mean_ms"):
+            v = snap[tag]
+            if v == v:  # skip NaN (no completed requests yet)
+                writer.scalar(f"serve/latency_{tag}", v, step)
+        for tag in ("admitted", "completed", "rejected_queue_full",
+                    "rejected_deadline", "rejected_shutdown", "failed"):
+            writer.scalar(f"serve/{tag}", snap[tag], step)
+        writer.scalar("serve/mean_batch_size", snap["mean_batch_size"], step)
+        writer.scalar("serve/mean_occupancy", snap["mean_occupancy"], step)
+        if queue_depth is not None:
+            writer.scalar("serve/queue_depth", queue_depth, step)
+        if cache:
+            writer.scalar("serve/cache_hits", cache.get("hits", 0), step)
+            writer.scalar("serve/cache_misses", cache.get("misses", 0), step)
+        with self._lock:
+            sizes = list(self._batch_sizes)
+            occ = list(self._occupancy)
+        if sizes:
+            writer.histogram("serve/batch_size", sizes, step)
+            writer.histogram("serve/batch_occupancy", occ, step)
+        writer.flush()

@@ -230,11 +230,14 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None):
         model."""
         _ensure_jit(state)
         try:
-            return compiled["fn"].lower(
+            ca = compiled["fn"].lower(
                 state, *_args(rest)
             ).compile().cost_analysis()
         except Exception:  # noqa: BLE001 — metrics aid, never fail a run
             return None
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+            ca = ca[0] if ca else None
+        return ca
 
     wrapper.cost_analysis = cost_analysis
     return wrapper

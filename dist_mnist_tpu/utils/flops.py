@@ -71,6 +71,8 @@ def step_flops(step_fn, *args) -> float | None:
             ca = step_fn.lower(*args).compile().cost_analysis()
     except Exception:  # noqa: BLE001 — metrics aid, never fail a run
         return None
+    if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+        ca = ca[0] if ca else None
     if ca is None:
         return None
     flops = ca.get("flops") if hasattr(ca, "get") else None

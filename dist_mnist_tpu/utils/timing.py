@@ -13,9 +13,27 @@ helper so a future clock fix lands in one place.
 
 from __future__ import annotations
 
+import contextlib
 import time
 
 import jax
+
+
+@contextlib.contextmanager
+def stopclock(acc: dict, key: str):
+    """Accumulate the block's wall time (seconds) into ``acc[key]`` and
+    bump ``acc[key + "_count"]`` — the serve-side compile/execute
+    attribution primitive (serve/engine.py). Callers timing device work
+    must keep the device_get-inside-the-block discipline this module's
+    docstring mandates: the clock can only stop on bytes actually handed
+    back to the host."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        dt = time.monotonic() - t0
+        acc[key] = acc.get(key, 0.0) + dt
+        acc[key + "_count"] = acc.get(key + "_count", 0) + 1
 
 
 def timed_chunks(run_fn, state, n_chunks: int):

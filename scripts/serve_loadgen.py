@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Standalone deterministic load generator against an in-process server.
+
+Thin wrapper over `dist_mnist_tpu.serve.loadgen.run_loadgen` (one
+definition shared with `cli/serve.py`, `bench.py --serve` and
+tests/test_serve.py) with a sweep mode: run the same deterministic load at
+several concurrency levels and print one JSON line each, so a latency/
+throughput knee is one script run.
+
+    python scripts/serve_loadgen.py --config mlp_mnist --requests 512 \
+        --concurrency 1,8,64 --platform cpu --host-device-count 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default="mlp_mnist")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--requests", type=int, default=512)
+    ap.add_argument("--concurrency", default="64",
+                    help="comma-separated sweep, e.g. 1,8,64")
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--queue-depth", type=int, default=256)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--host-device-count", type=int, default=None)
+    args = ap.parse_args()
+
+    from dist_mnist_tpu.cluster import initialize_distributed
+
+    initialize_distributed(
+        None, 1, 0,
+        platform=args.platform, host_device_count=args.host_device_count,
+    )
+
+    from dist_mnist_tpu.cluster.mesh import make_mesh
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.serve import (
+        InferenceEngine,
+        InferenceServer,
+        ServeConfig,
+        load_for_serving,
+        run_loadgen,
+    )
+
+    cfg = get_config(args.config)
+    mesh = make_mesh(cfg.mesh)
+    bundle = load_for_serving(cfg, mesh, checkpoint_dir=args.checkpoint_dir)
+    engine = InferenceEngine(
+        bundle.model, bundle.params, bundle.model_state, mesh,
+        model_name=cfg.model, image_shape=bundle.image_shape,
+        rules=bundle.rules, max_bucket=args.max_batch,
+    )
+    for conc in (int(c) for c in args.concurrency.split(",")):
+        # fresh server per level: each level's stats stand alone
+        server = InferenceServer(engine, ServeConfig(
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth,
+            default_deadline_ms=args.deadline_ms,
+        ))
+        with server:
+            summary = run_loadgen(
+                server,
+                n_requests=args.requests,
+                concurrency=conc,
+                image_shape=bundle.image_shape,
+                seed=args.seed,
+            )
+        print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

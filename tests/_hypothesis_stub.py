@@ -1,0 +1,35 @@
+"""`hypothesis` import shim for the property tests.
+
+The image this repo targets does not always ship `hypothesis` (and the
+no-new-deps rule forbids installing it). Importing it at module top level
+made three whole test modules ERROR at collection, losing every
+non-property test in them. This shim re-exports the real library when
+present; otherwise the property tests skip individually and the rest of
+each module still runs.
+"""
+
+from __future__ import annotations
+
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: F401
+
+    HAVE_HYPOTHESIS = True
+except ModuleNotFoundError:
+    import pytest
+
+    HAVE_HYPOTHESIS = False
+
+    def given(*_args, **_kwargs):
+        return lambda fn: pytest.mark.skip(reason="hypothesis not installed")(fn)
+
+    def settings(*_args, **_kwargs):
+        return lambda fn: fn
+
+    class _AnyStrategy:
+        """Stands in for `strategies`: every attribute is a callable
+        returning None — the stub `given` never evaluates strategies."""
+
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    st = _AnyStrategy()

@@ -307,3 +307,102 @@ def test_flipped_layout_plus_partial_metrics_heals(tmp_path, mesh8):
         np.asarray(st_unrolled.params["block0"]["attn"]["qkv"]["w"]),
         rtol=1e-6)
     mgr.close()
+
+
+def test_healing_classifier_ignores_error_wording(tmp_path, state,
+                                                  monkeypatch):
+    """A structure mismatch must enter the healing ladder regardless of how
+    the underlying Orbax version WORDS its ValueError (ADVICE r5): the
+    classifier probes the on-disk tree metadata, not the message. Simulated
+    by re-raising the first restore failure with nonsense wording."""
+    import dataclasses
+
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(state)
+    mgr.wait()
+    # target with an extra _metric entry: genuinely mismatched vs on-disk
+    target = dataclasses.replace(
+        state, model_state={**state.model_state,
+                            "bogus_health_metric": jnp.zeros(())})
+    orig = mgr._restore_into
+    fired = []
+
+    def reworded(step, tgt):
+        try:
+            return orig(step, tgt)
+        except Exception:
+            if not fired:  # only the FIRST failure gets reworded
+                fired.append(1)
+                raise ValueError("kaboom: completely novel phrasing 0x7f")
+            raise
+
+    monkeypatch.setattr(mgr, "_restore_into", reworded)
+    restored = mgr.restore(target)  # heals despite the unknown wording
+    assert restored is not None
+    assert "bogus_health_metric" in restored.model_state
+    mgr.close()
+
+
+def test_non_structural_keyerror_skips_healing(tmp_path, state, monkeypatch):
+    """A KeyError naming a key that exists in NEITHER the target tree nor
+    the on-disk metadata is not structural — it must propagate immediately
+    instead of buying extra full restore attempts."""
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(state)
+    mgr.wait()
+    calls = []
+    monkeypatch.setattr(
+        mgr, "_restore_with_structure_healing",
+        lambda *a, **k: calls.append(1))
+    monkeypatch.setattr(
+        mgr, "_restore_into",
+        lambda *a, **k: (_ for _ in ()).throw(
+            KeyError("definitely_not_a_tree_key")))
+    with pytest.raises(KeyError, match="definitely_not_a_tree_key"):
+        mgr.restore(state)
+    assert not calls
+    mgr.close()
+
+
+def test_structural_keyerror_enters_healing(tmp_path, state, monkeypatch):
+    """A KeyError naming an actual tree key (here a model_state/params-tree
+    name) IS structural evidence and must reach the ladder."""
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(state)
+    mgr.wait()
+    calls = []
+    monkeypatch.setattr(
+        mgr, "_restore_with_structure_healing",
+        lambda step, tgt, err: calls.append(1) or state)
+    key = next(iter(state.params))  # a real params tree key
+    monkeypatch.setattr(
+        mgr, "_restore_into",
+        lambda *a, **k: (_ for _ in ()).throw(KeyError(key)))
+    assert mgr.restore(state) is state
+    assert calls == [1]
+    mgr.close()
+
+
+def test_restore_weights_no_optimizer(tmp_path, state):
+    """serve-side weights-only restore: params/model_state come back (with
+    the requested shardings), the optimizer slots never enter the target."""
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(state)
+    mgr.wait()
+    absify = lambda t: jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        t)
+    out = mgr.restore_weights(absify(state.params),
+                              absify(state.model_state))
+    assert out is not None
+    step, params, model_state = out
+    assert step == state.step_int
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_restore_weights_empty_dir(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    assert mgr.restore_weights({}, {}) is None
+    mgr.close()

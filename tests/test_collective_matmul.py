@@ -45,7 +45,8 @@ def test_matmul_reducescatter_matches_dense(mesh8m):
     out = matmul_reducescatter(x, w, mesh8m)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x) @ np.asarray(w),
                                rtol=1e-5, atol=1e-5)
-    assert out.sharding.spec == P("model", None)
+    # older jax canonicalizes away the trailing None in the spec
+    assert out.sharding.spec in (P("model", None), P("model"))
 
 
 def test_collective_matmul_differentiates(mesh8m):

@@ -159,7 +159,7 @@ def test_native_batcher_start_step_seeks(mesh8, small_mnist):
 
 # ---- property tests (SURVEY.md §4: hypothesis for the sharding math) -------
 
-from hypothesis import given, settings, strategies as st
+from _hypothesis_stub import given, settings, st
 
 
 @settings(max_examples=50, deadline=None)

@@ -1,0 +1,73 @@
+"""The flash replicated-batch fallback must WARN, once per trace.
+
+When `batch % data != 0` on a mesh with a real model axis, the flash
+shard_map drops the data axis and every device recomputes the full
+replicated batch — a silent O(data)x compute/memory cliff (ADVICE r5,
+mirroring moe.py's dense-fallback warning). These tests pin the warning's
+existence, its once-per-trace cadence (a jit-cached fallback would
+otherwise be invisible after the first step), and its absence on the
+well-shaped path. Kept separate from test_parallel_attention.py: this is
+log-contract coverage, not numerics."""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+import pytest
+
+from dist_mnist_tpu.cluster.mesh import activate
+from dist_mnist_tpu.parallel.flash import flash_attention_sharded
+
+_LOGGER = "dist_mnist_tpu.parallel.flash"
+
+
+def _qkv(batch, seq=8, heads=2, dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jax.numpy.asarray(
+        rng.normal(size=(batch, seq, heads, dim)), jax.numpy.float32)
+    return mk(), mk(), mk()
+
+
+def _warnings(caplog):
+    return [r for r in caplog.records
+            if r.name == _LOGGER and "drops the data axis" in r.message]
+
+
+def test_replicated_batch_warns_once_per_trace(mesh_tp, caplog):
+    q, k, v = _qkv(batch=3)  # 3 % data(4) != 0 -> replicated fallback
+    fn = jax.jit(flash_attention_sharded)
+    with activate(mesh_tp), caplog.at_level(logging.WARNING, logger=_LOGGER):
+        out1 = fn(q, k, v)
+        out2 = fn(q, k, v)  # cache hit: no retrace, no second warning
+    assert out1.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+    assert len(_warnings(caplog)) == 1
+    msg = _warnings(caplog)[0].getMessage()
+    assert "batch=3" in msg and "4x redundant" in msg
+
+
+def test_new_trace_warns_again(mesh_tp, caplog):
+    # fresh lambda: jax's trace cache is keyed on the function object, and
+    # this test must own its traces (batch sizes also unique to this test)
+    fn = jax.jit(lambda a, b, c: flash_attention_sharded(a, b, c))
+    with activate(mesh_tp), caplog.at_level(logging.WARNING, logger=_LOGGER):
+        fn(*_qkv(batch=6, seed=1))
+        fn(*_qkv(batch=7, seed=2))  # new shape -> new trace -> new warning
+    assert len(_warnings(caplog)) == 2
+
+
+def test_divisible_batch_does_not_warn(mesh_tp, caplog):
+    q, k, v = _qkv(batch=4)  # 4 % data(4) == 0 -> rides the data axis
+    with activate(mesh_tp), caplog.at_level(logging.WARNING, logger=_LOGGER):
+        out = jax.jit(flash_attention_sharded)(q, k, v)
+    assert out.shape == q.shape
+    assert not _warnings(caplog)
+
+
+def test_indivisible_heads_still_refused(mesh_tp):
+    q, k, v = _qkv(batch=4, heads=3)  # 3 % model(2) != 0
+    with activate(mesh_tp):
+        with pytest.raises(ValueError, match="heads=3 % model=2"):
+            flash_attention_sharded(q, k, v)

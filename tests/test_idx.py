@@ -3,7 +3,7 @@ error paths."""
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+from _hypothesis_stub import given, settings, st
 
 from dist_mnist_tpu.data.idx import read_idx, write_idx
 

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+from _hypothesis_stub import given, settings, st
 
 from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
 from dist_mnist_tpu.ops.nn import dot_product_attention
@@ -244,8 +244,10 @@ def test_ring_flash_grads_match_dense(mesh_seq):
 def test_ring_flash_rejects_unknown_impl(mesh_seq):
     from dist_mnist_tpu.parallel.ring_attention import ring_attention_inner
 
+    from dist_mnist_tpu.cluster.mesh import compat_shard_map
+
     with pytest.raises(ValueError, match="ring attention impl 'einsum'"):
-        jax.shard_map(
+        compat_shard_map(
             lambda q, k, v: ring_attention_inner(q, k, v, impl="einsum"),
             mesh=mesh_seq,
             in_specs=(None, None, None),
