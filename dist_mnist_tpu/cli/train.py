@@ -79,6 +79,17 @@ flags.DEFINE_enum("input_pipeline", "python",
                   "with-replacement sampling fused into the compiled step — "
                   "zero host work per step) | device_sharded (same, rows "
                   "sharded over the data axis for capacity)")
+flags.DEFINE_integer("prefetch_depth", 2,
+                     "device-prefetch ring depth for the host input paths "
+                     "(python/native): a background worker issues sharded "
+                     "H2D transfers this many batches ahead so the copy "
+                     "overlaps the running step (data/prefetch.py); 0 = "
+                     "synchronous feed")
+flags.DEFINE_integer("runahead", 0,
+                     "bound host dispatch runahead: wait on the k-th oldest "
+                     "in-flight step before dispatching the next (caps HBM "
+                     "held by undonated in-flight buffers without a "
+                     "per-step sync); 0 = unbounded")
 flags.DEFINE_integer("max_recoveries", 3,
                      "preemption restore attempts (needs checkpoint_dir)")
 flags.DEFINE_integer("scan_chunk", 0,
@@ -148,6 +159,8 @@ def _run_config(
     mesh=None,
     input_pipeline: str = "python",
     scan_chunk: int = 0,
+    prefetch_depth: int = 0,
+    runahead: int = 0,
 ):
     """Implementation behind `run_config` (the public wrapper adds the
     PRNG-impl scope — call THAT, not this).
@@ -268,6 +281,7 @@ def _run_config(
             hooks_lib.StepCounterHook(
                 every_steps=cfg.log_every, batch_size=cfg.batch_size, writer=writer
             ),
+            hooks_lib.InputPipelineHook(writer, every_steps=cfg.log_every),
             hooks_lib.LoggingHook(every_steps=cfg.log_every),
             hooks_lib.SummaryHook(writer, every_steps=cfg.log_every),
             hooks_lib.NaNGuardHook(),
@@ -306,6 +320,12 @@ def _run_config(
             batches = ShardedBatcher(dataset, cfg.batch_size, mesh,
                                      seed=cfg.seed,
                                      start_step=state.step_int)
+        if prefetch_depth and not input_pipeline.startswith("device"):
+            # overlap H2D with the running step; the device pipelines have
+            # no feed to overlap (sampling is inside the compiled step)
+            from dist_mnist_tpu.data.prefetch import DevicePrefetcher
+
+            batches = DevicePrefetcher(batches, depth=prefetch_depth)
         loop = TrainLoop(
             step_fn,
             state,
@@ -314,6 +334,7 @@ def _run_config(
             checkpoint_manager=manager,
             max_recoveries=max_recoveries,
             steps_per_call=max(1, scan_chunk),
+            runahead=runahead,
         )
         state = loop.run()
         # EvalHook.end already evaluated the final state; don't pay for a
@@ -413,6 +434,8 @@ def main(argv):
         max_recoveries=FLAGS.max_recoveries if FLAGS.checkpoint_dir else 0,
         input_pipeline=FLAGS.input_pipeline,
         scan_chunk=FLAGS.scan_chunk,
+        prefetch_depth=FLAGS.prefetch_depth,
+        runahead=FLAGS.runahead,
     )
 
 

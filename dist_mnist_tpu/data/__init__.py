@@ -9,6 +9,8 @@ Replaces the reference's MNIST input module (SURVEY.md §2.1 row 2:
   with disk-first, synthetic-fallback loading.
 - `pipeline.py` — deterministic shuffled batching, per-host sharding, and a
   device-resident fast path that fuses batch sampling into the jit step.
+- `prefetch.py` — `DevicePrefetcher`: background worker issuing sharded
+  H2D transfers `depth` batches ahead of the loop (overlapped input feed).
 """
 
 from dist_mnist_tpu.data.idx import read_idx, write_idx
@@ -19,6 +21,7 @@ from dist_mnist_tpu.data.pipeline import (
     DeviceDataset,
     shard_batch,
 )
+from dist_mnist_tpu.data.prefetch import DevicePrefetcher
 
 __all__ = [
     "read_idx",
@@ -29,5 +32,6 @@ __all__ = [
     "epoch_batches",
     "ShardedBatcher",
     "DeviceDataset",
+    "DevicePrefetcher",
     "shard_batch",
 ]

@@ -86,15 +86,23 @@ class NativeBatcher:
             raise StopIteration
         return img, lab, int(step)
 
-    def __iter__(self):
-        from dist_mnist_tpu.data.pipeline import shard_batch
-
+    def host_batches(self):
+        """Host-side half of the stream (numpy, pre-placement) — the same
+        split ShardedBatcher.host_batches makes, so `DevicePrefetcher`
+        (data/prefetch.py) can issue the sharded transfer in its worker
+        on top of the C++ assembly ring."""
         while True:
             try:
                 img, lab, _ = self.next_local()
             except StopIteration:
                 return
-            yield shard_batch({"image": img, "label": lab}, self.mesh)
+            yield {"image": img, "label": lab}
+
+    def __iter__(self):
+        from dist_mnist_tpu.data.pipeline import shard_batch
+
+        for batch in self.host_batches():
+            yield shard_batch(batch, self.mesh)
 
     def at_step(self, step: int) -> "NativeBatcher":
         """A fresh batcher positioned at `step` — non-destructive, matching

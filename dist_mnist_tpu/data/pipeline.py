@@ -80,7 +80,11 @@ class ShardedBatcher:
         """A batcher positioned at `step` (TrainLoop recovery re-seek)."""
         return dataclasses.replace(self, start_step=step)
 
-    def __iter__(self) -> Iterator[dict[str, jax.Array]]:
+    def host_batches(self) -> Iterator[dict[str, np.ndarray]]:
+        """Host-side half of the stream: this process's numpy slice of each
+        global batch, BEFORE device placement. Split out from `__iter__` so
+        `DevicePrefetcher` (data/prefetch.py) can pull host batches in its
+        worker and issue the sharded transfer off the training thread."""
         n = self.dataset.train_images.shape[0]
         n_proc, pid = jax.process_count(), jax.process_index()
         if self.global_batch % n_proc:
@@ -105,15 +109,16 @@ class ShardedBatcher:
                 if b < skip:
                     continue
                 mine = idx[pid * local : (pid + 1) * local]
-                yield shard_batch(
-                    {
-                        "image": self.dataset.train_images[mine],
-                        "label": self.dataset.train_labels[mine],
-                    },
-                    self.mesh,
-                )
+                yield {
+                    "image": self.dataset.train_images[mine],
+                    "label": self.dataset.train_labels[mine],
+                }
             skip = 0
             epoch += 1
+
+    def __iter__(self) -> Iterator[dict[str, jax.Array]]:
+        for batch in self.host_batches():
+            yield shard_batch(batch, self.mesh)
 
 
 class DeviceDataset:

@@ -10,6 +10,7 @@ from dist_mnist_tpu.hooks.base import Hook
 from dist_mnist_tpu.hooks.builtin import (
     StopAtStepHook,
     StepCounterHook,
+    InputPipelineHook,
     LoggingHook,
     NaNGuardHook,
     NanLossError,
@@ -26,6 +27,7 @@ __all__ = [
     "Hook",
     "StopAtStepHook",
     "StepCounterHook",
+    "InputPipelineHook",
     "LoggingHook",
     "NaNGuardHook",
     "NanLossError",

@@ -22,6 +22,8 @@ log = logging.getLogger(__name__)
 class MetricWriter(Protocol):
     def scalar(self, tag: str, value: float, step: int) -> None: ...
 
+    def scalars(self, values: dict, step: int) -> None: ...
+
     def histogram(self, tag: str, values, step: int) -> None: ...
 
     def flush(self) -> None: ...
@@ -43,6 +45,12 @@ def _summary_stats(values) -> dict[str, float]:
 class StdoutWriter:
     def scalar(self, tag, value, step):
         log.info("[metric] step=%d %s=%.6g", step, tag, value)
+
+    def scalars(self, values, step):
+        # one line per batch, not per tag — batched writes exist so a
+        # multi-metric cadence costs one writer call (hooks/builtin.py)
+        log.info("[metric] step=%d %s", step,
+                 " ".join(f"{k}={v:.6g}" for k, v in values.items()))
 
     def histogram(self, tag, values, step):
         s = _summary_stats(values)
@@ -69,6 +77,9 @@ class CsvWriter:
     def scalar(self, tag, value, step):
         self._writer.writerow([step, tag, value])
 
+    def scalars(self, values, step):
+        self._writer.writerows([step, k, v] for k, v in values.items())
+
     def histogram(self, tag, values, step):
         for k, v in _summary_stats(values).items():
             self._writer.writerow([step, f"{tag}/{k}", v])
@@ -94,6 +105,11 @@ class TensorBoardWriter:
         if self._w is not None:
             self._w.write_scalars(step, {tag: value})
 
+    def scalars(self, values, step):
+        # clu's native API IS batched; one event-file record for the set
+        if self._w is not None:
+            self._w.write_scalars(step, dict(values))
+
     def histogram(self, tag, values, step):
         # full-distribution summaries — the reference's arbitrary-proto
         # summary path ($TF basic_session_run_hooks.py:793) beyond scalars
@@ -112,6 +128,17 @@ class MultiWriter:
     def scalar(self, tag, value, step):
         for w in self.writers:
             w.scalar(tag, value, step)
+
+    def scalars(self, values, step):
+        for w in self.writers:
+            # pre-batch custom writers (scalar/flush only) degrade to a
+            # per-tag loop instead of crashing
+            batch_write = getattr(w, "scalars", None)
+            if callable(batch_write):
+                batch_write(values, step)
+            else:
+                for k, v in values.items():
+                    w.scalar(k, v, step)
 
     def histogram(self, tag, values, step):
         for w in self.writers:

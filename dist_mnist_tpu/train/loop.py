@@ -16,8 +16,12 @@ onto plain control flow:
 
 from __future__ import annotations
 
+import collections
 import logging
+import time
 from typing import Iterable, Sequence
+
+import jax
 
 from dist_mnist_tpu.hooks.base import Hook
 from dist_mnist_tpu.train.state import TrainState
@@ -84,6 +88,7 @@ class TrainLoop:
         checkpoint_manager=None,
         max_recoveries: int = 0,
         steps_per_call: int = 1,
+        runahead: int = 0,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -96,6 +101,17 @@ class TrainLoop:
         # train/step.make_scanned_train_fn): hooks fire once per chunk at
         # the post-chunk step number; cadences/stops round up to the chunk.
         self.steps_per_call = steps_per_call
+        # dispatch-runahead bound: keep at most `runahead` step outputs
+        # in flight and wait on the OLDEST before dispatching the next
+        # call — bounds host runahead (and the HBM held by undonated
+        # in-flight buffers) without a per-step sync. 0 = unbounded.
+        self.runahead = runahead
+        self._inflight: collections.deque = collections.deque()
+        # input-stall attribution, cumulative seconds (hooks read these —
+        # hooks/builtin.InputPipelineHook): time blocked pulling the next
+        # batch, and time blocked on the runahead bound.
+        self.feed_wait_s = 0.0
+        self.runahead_wait_s = 0.0
         self.initial_step = state.step_int
         self._host_step = self.initial_step  # host mirror of state.step:
         # tracks the global step without a device sync per step
@@ -110,12 +126,21 @@ class TrainLoop:
         it = iter(self.batches)
         try:
             while not self.stop.should_stop():
+                t_feed = time.monotonic()
                 try:
                     batch = next(it)
                 except StopIteration:
                     self.request_stop("data exhausted")
                     break
+                self.feed_wait_s += time.monotonic() - t_feed
                 try:
+                    # runahead bound: before dispatching this call, wait on
+                    # the OLDEST in-flight output — one wait per step, never
+                    # a sync on the step just dispatched
+                    if self.runahead and len(self._inflight) >= self.runahead:
+                        t_wait = time.monotonic()
+                        jax.block_until_ready(self._inflight.popleft())
+                        self.runahead_wait_s += time.monotonic() - t_wait
                     # step number BEFORE the step executes == the step being
                     # run; hooks see the post-step number like global_step
                     # reads did after the AssignAdd (§3.3).
@@ -124,9 +149,15 @@ class TrainLoop:
                     new_state, outputs = self.step_fn(self.state, batch)
                     self.state = new_state
                     self._host_step += self.steps_per_call
+                    if self.runahead:
+                        self._inflight.append(outputs)
                     for h in self.hooks:
                         h.after_step(self._host_step, self.state, outputs)
                 except Exception as exc:  # noqa: BLE001 — classified below
+                    # in-flight outputs reference pre-failure buffers;
+                    # waiting on them after a restore could resurface the
+                    # same device error
+                    self._inflight.clear()
                     if not (
                         _is_preemption(exc)
                         and self.checkpoint_manager is not None
@@ -148,9 +179,16 @@ class TrainLoop:
                     # (batches consumed between checkpoint and failure must
                     # be replayed, not skipped)
                     if hasattr(self.batches, "at_step"):
+                        if hasattr(it, "close"):
+                            it.close()  # drain a prefetch worker promptly
                         self.batches = self.batches.at_step(self._host_step)
                         it = iter(self.batches)
         finally:
+            self._inflight.clear()
+            # generators (incl. DevicePrefetcher streams) drain their
+            # resources here — on normal exit AND on an escaping exception
+            if hasattr(it, "close"):
+                it.close()
             for h in self.hooks:
                 try:
                     h.end(self.state)

@@ -52,6 +52,28 @@ def rng():
     return jax.random.PRNGKey(0)
 
 
+@pytest.fixture(autouse=True)
+def _no_leaked_prefetch_workers():
+    """Every DevicePrefetcher worker must be joined by test end — a leaked
+    worker means some path (exception, early close, re-seek) skipped the
+    stream drain. Polls briefly: a worker that JUST saw its stop flag may
+    still be mid-exit when the test returns."""
+    import threading
+    import time
+
+    from dist_mnist_tpu.data.prefetch import THREAD_NAME_PREFIX
+
+    yield
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith(THREAD_NAME_PREFIX) and t.is_alive()]
+        if not leaked:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"leaked DevicePrefetcher worker threads: {leaked}")
+
+
 @pytest.fixture(scope="session")
 def small_mnist():
     """Small synthetic MNIST so tests stay fast."""

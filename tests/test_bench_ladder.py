@@ -89,3 +89,66 @@ def test_probe_backend_failure_carries_committed_anchor(monkeypatch, capsys):
     assert out["value"] == 0.0 and "error" in out
     anchor = out["extra"]["last_committed_anchor"]
     assert anchor["value"] > 0 and "NOT produced by this run" in anchor["note"]
+
+
+class _FakeProc:
+    def __init__(self, returncode, stdout="", stderr=""):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def test_probe_timeout_env_override(monkeypatch):
+    """BENCH_PROBE_TIMEOUT_S must override the per-attempt subprocess
+    deadline (CI smoke lanes shrink a 150 s probe to seconds)."""
+    import subprocess
+
+    seen = []
+
+    def fake_run(cmd, capture_output, text, timeout):
+        seen.append(timeout)
+        return _FakeProc(0, stdout="DEVCOUNT 8")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "7")
+    assert bench._probe(retries=3, timeout_s=150) == []
+    assert seen == [7]
+
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "not-a-number")
+    assert bench._probe(retries=1, timeout_s=150) == []
+    assert seen[-1] == 150  # junk override falls back to the default
+
+
+def test_probe_short_circuits_on_connection_refused(monkeypatch):
+    """A connection-refused-class failure means the relay is DOWN, not
+    flaky: remaining attempts (and their backoff sleeps) must be skipped."""
+    import subprocess
+
+    attempts = []
+
+    def fake_run(cmd, capture_output, text, timeout):
+        attempts.append(1)
+        return _FakeProc(1, stderr="RPC failed: Connection refused (ECONNREFUSED)")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: (_ for _ in ()).throw(
+                            AssertionError("backoff sleep after a fatal error")))
+    errs = bench._probe(retries=3, timeout_s=1)
+    assert len(attempts) == 1  # short-circuited after the first attempt
+    assert len(errs) == 1 and "short-circuited" in errs[0]
+
+
+def test_probe_still_retries_transient_errors(monkeypatch):
+    import subprocess
+
+    attempts = []
+
+    def fake_run(cmd, capture_output, text, timeout):
+        attempts.append(1)
+        return _FakeProc(1, stderr="transient tunnel hiccup")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    errs = bench._probe(retries=3, timeout_s=1)
+    assert len(attempts) == 3 and len(errs) == 3

@@ -120,6 +120,31 @@ def test_nan_guard_stop_mode():
     assert loop.stop.reason == "non-finite loss"
 
 
+def test_logging_hook_single_sync_per_cadence(monkeypatch):
+    """Every logged key rides ONE jax.device_get per cadence — per-key
+    float() was one blocking host sync per metric, serializing dispatch."""
+    import jax
+
+    from dist_mnist_tpu.hooks import builtin
+
+    def multi_metric_step(state, batch):
+        state, _ = _fake_step(state, batch)
+        return state, {"loss": jnp.float32(0.5), "accuracy": jnp.float32(0.9),
+                       "grad_norm": jnp.float32(1.2)}
+
+    loop = TrainLoop(multi_metric_step, _state(), itertools.repeat(1.0),
+                     [LoggingHook(every_steps=2), StopAtStepHook(last_step=4)])
+
+    # patch AFTER loop construction (builtin.jax IS the jax module, and
+    # TrainLoop.__init__'s state.step_int would otherwise count as a sync)
+    gets = []
+    real_get = jax.device_get
+    monkeypatch.setattr(builtin.jax, "device_get",
+                        lambda tree: gets.append(1) or real_get(tree))
+    loop.run()
+    assert len(gets) == 2  # cadences at steps 2 and 4: one sync each
+
+
 def test_step_counter_rate():
     hook = StepCounterHook(every_steps=5, batch_size=32)
     loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),

@@ -1,0 +1,346 @@
+"""DevicePrefetcher: stream equivalence, trajectory identity, recovery
+re-seek, worker lifecycle, and the TrainLoop runahead bound.
+
+The determinism contract under test: a prefetched feed is an OVERLAP
+optimization only — it must never reorder, drop, or duplicate batches, so
+everything downstream (loss trajectories, recovery replay) is bit-identical
+to the synchronous feed.
+"""
+
+import collections
+import itertools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_mnist_tpu import optim
+from dist_mnist_tpu.cluster.mesh import activate
+from dist_mnist_tpu.data.pipeline import ShardedBatcher
+from dist_mnist_tpu.data.prefetch import (
+    THREAD_NAME_PREFIX,
+    DevicePrefetcher,
+    PrefetchStats,
+)
+from dist_mnist_tpu.hooks import InputPipelineHook, StopAtStepHook
+from dist_mnist_tpu.models import get_model
+from dist_mnist_tpu.train import create_train_state
+from dist_mnist_tpu.train.loop import PreemptionError, TrainLoop
+from dist_mnist_tpu.train.state import TrainState
+from dist_mnist_tpu.train.step import make_train_step
+
+
+def _live_workers():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(THREAD_NAME_PREFIX) and t.is_alive()]
+
+
+def _wait_drained(timeout=2.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _live_workers():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _take(iterable, n):
+    """First n items, CLOSING the iterator (islice would leave a prefetch
+    worker running behind a suspended generator)."""
+    it = iter(iterable)
+    try:
+        return [next(it) for _ in range(n)]
+    finally:
+        if hasattr(it, "close"):
+            it.close()
+
+
+def _host(batch):
+    return {k: np.asarray(v) for k, v in batch.items()}
+
+
+# ---------------------------------------------------------------- stream
+
+
+def test_prefetched_stream_identical_to_sync(small_mnist, mesh8):
+    """≥2 epochs (8 steps/epoch at batch 512 on the 4096-row set): the
+    prefetched stream is the sync stream, batch for batch, bit for bit."""
+    sync = _take(ShardedBatcher(small_mnist, 512, mesh8, seed=0), 20)
+    pre = _take(
+        DevicePrefetcher(ShardedBatcher(small_mnist, 512, mesh8, seed=0),
+                         depth=3), 20)
+    for s, p in zip(sync, pre):
+        hs, hp = _host(s), _host(p)
+        np.testing.assert_array_equal(hs["image"], hp["image"])
+        np.testing.assert_array_equal(hs["label"], hp["label"])
+
+
+def test_prefetched_batches_are_device_resident(small_mnist, mesh8):
+    (batch,) = _take(
+        DevicePrefetcher(ShardedBatcher(small_mnist, 512, mesh8)), 1)
+    assert isinstance(batch["image"], jax.Array)
+    assert batch["image"].sharding.mesh.shape == mesh8.shape
+
+
+def test_at_step_reseek_matches_inner(small_mnist, mesh8):
+    inner = ShardedBatcher(small_mnist, 512, mesh8, seed=0)
+    want = _take(inner.at_step(5), 4)
+    got = _take(DevicePrefetcher(inner, depth=2).at_step(5), 4)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(_host(w)["label"], _host(g)["label"])
+
+
+def test_at_step_requires_seekable_inner():
+    with pytest.raises(TypeError, match="at_step"):
+        DevicePrefetcher(itertools.repeat({"x": np.zeros(1)})).at_step(3)
+
+
+def test_depth_must_be_positive(small_mnist, mesh8):
+    with pytest.raises(ValueError, match="depth"):
+        DevicePrefetcher(ShardedBatcher(small_mnist, 512, mesh8), depth=0)
+
+
+# ------------------------------------------------------ worker lifecycle
+
+
+def test_worker_drains_on_exhaustion():
+    items = [{"x": np.ones(4)} for _ in range(5)]
+    got = list(DevicePrefetcher(items, depth=2))
+    assert len(got) == 5
+    assert _wait_drained()
+
+
+def test_inner_exception_propagates_and_drains():
+    def bad():
+        yield {"x": np.ones(4)}
+        yield {"x": np.ones(4)}
+        raise ValueError("corrupt shard")
+
+    class _Seekless:
+        def __init__(self, gen):
+            self._gen = gen
+
+        def __iter__(self):
+            return self._gen()
+
+    pf = DevicePrefetcher(_Seekless(bad), depth=2)
+    with pytest.raises(ValueError, match="corrupt shard"):
+        list(pf)
+    assert _wait_drained()
+
+
+def test_early_close_drains_worker(small_mnist, mesh8):
+    """Closing mid-stream (what TrainLoop's finally does) must reap the
+    worker even while it is blocked on a full ring."""
+    pf = DevicePrefetcher(ShardedBatcher(small_mnist, 512, mesh8), depth=2)
+    it = iter(pf)
+    next(it)
+    assert _live_workers()  # worker is up and filling the ring
+    it.close()
+    assert _wait_drained()
+
+
+def test_prefetcher_close_reaps_all_streams(small_mnist, mesh8):
+    pf = DevicePrefetcher(ShardedBatcher(small_mnist, 512, mesh8), depth=2)
+    it = iter(pf)
+    next(it)
+    pf.close()
+    assert _wait_drained()
+    it.close()
+
+
+def test_stats_accumulate(small_mnist, mesh8):
+    pf = DevicePrefetcher(ShardedBatcher(small_mnist, 512, mesh8), depth=2)
+    _take(pf, 6)
+    s = pf.stats()
+    assert s["batches"] == 6
+    assert s["h2d_bytes"] > 0
+    assert s["depth"] == 2
+    assert 0.0 <= s["mean_occupancy"] <= 2.0
+
+
+# ------------------------------------------------- training equivalence
+
+
+def _mlp_setup(small_mnist, mesh):
+    model = get_model("mlp")
+    optimizer = optim.adam(1e-3)
+    state = create_train_state(
+        model, optimizer, jax.random.PRNGKey(0), small_mnist.train_images[:1]
+    )
+    # donate=False: the SAME initial state feeds both trajectories
+    step = make_train_step(model, optimizer, mesh, donate=False)
+    return state, step
+
+
+def _loss_trajectory(step, state, batches, n_steps):
+    losses = []
+    it = iter(batches)
+    try:
+        for _ in range(n_steps):
+            state, out = step(state, next(it))
+            losses.append(float(jax.device_get(out["loss"])))
+    finally:
+        if hasattr(it, "close"):
+            it.close()
+    return losses
+
+
+def test_loss_trajectory_bit_identical(small_mnist, mesh8):
+    """Two full epochs of real MLP training: prefetched feed reproduces the
+    sync feed's loss trajectory EXACTLY (not approximately)."""
+    with activate(mesh8):
+        state, step = _mlp_setup(small_mnist, mesh8)
+        n = 16  # 2 epochs at 8 steps/epoch
+        sync = _loss_trajectory(
+            step, state, ShardedBatcher(small_mnist, 512, mesh8, seed=0), n)
+        pre = _loss_trajectory(
+            step, state,
+            DevicePrefetcher(ShardedBatcher(small_mnist, 512, mesh8, seed=0),
+                             depth=3), n)
+    assert sync == pre  # bit-identical, no tolerance
+
+
+# ------------------------------------------------------ loop integration
+
+
+def _loop_state(step=0):
+    return TrainState(
+        step=jnp.int32(step), params={}, model_state={}, opt_state={},
+        rng=jnp.zeros((2,), jnp.uint32),
+    )
+
+
+class _MemoryCkpt:
+    def __init__(self):
+        self.saved = None
+
+    def save(self, state):
+        self.saved = state
+
+    def restore(self, target):
+        return self.saved
+
+
+class _RecordingFlakyStep:
+    """Records each consumed batch's label checksum; raises PreemptionError
+    on the call indices in `fail_at` (batch consumed but NOT recorded —
+    exactly the consumed-then-lost case replay must cover)."""
+
+    def __init__(self, fail_at=()):
+        self.fail_at = set(fail_at)
+        self.calls = 0
+        self.seen = []
+
+    def __call__(self, state, batch):
+        n = self.calls
+        self.calls += 1
+        if n in self.fail_at:
+            raise PreemptionError("injected preemption")
+        self.seen.append(int(np.asarray(batch["label"]).sum()))
+        return (
+            TrainState(step=state.step + 1, params=state.params,
+                       model_state=state.model_state,
+                       opt_state=state.opt_state, rng=state.rng),
+            {"loss": jnp.float32(0.0)},
+        )
+
+
+def test_recovery_replays_through_prefetcher(small_mnist, mesh8):
+    """Preemption mid-stream with a prefetched feed: restore + at_step
+    re-seek must REPLAY the batches consumed since the checkpoint (the ring
+    had already pulled ahead), not skip past them."""
+    expected = [int(_host(b)["label"].sum()) for b in
+                _take(ShardedBatcher(small_mnist, 512, mesh8, seed=0), 6)]
+
+    step = _RecordingFlakyStep(fail_at={3})
+    mgr = _MemoryCkpt()
+    state = _loop_state()
+    mgr.save(state)  # checkpoint at step 0
+
+    batches = DevicePrefetcher(
+        ShardedBatcher(small_mnist, 512, mesh8, seed=0), depth=2)
+    loop = TrainLoop(step, state, batches, [StopAtStepHook(last_step=6)],
+                     checkpoint_manager=mgr, max_recoveries=1)
+    final = loop.run()
+
+    assert final.step_int == 6
+    # calls 0-2 trained on b0..b2; call 3 lost b3 to the preemption; the
+    # recovered run replays b0..b5 from the restored step — nothing skipped
+    assert step.seen == expected[:3] + expected[:6]
+    assert _wait_drained()
+    # the re-seeked prefetcher shares the stats object: counts accumulate
+    assert loop.batches.stats()["batches"] >= 9
+
+
+def test_runahead_bounds_inflight_outputs():
+    observed = []
+
+    def fake_step(state, batch):
+        return (
+            TrainState(step=state.step + 1, params=state.params,
+                       model_state=state.model_state,
+                       opt_state=state.opt_state, rng=state.rng),
+            {"loss": jnp.float32(1.0)},
+        )
+
+    loop = TrainLoop(fake_step, _loop_state(), itertools.repeat(1.0),
+                     [StopAtStepHook(last_step=12)], runahead=2)
+
+    class _WatchedDeque(collections.deque):
+        def append(self, x):
+            super().append(x)
+            observed.append(len(self))
+
+    loop._inflight = _WatchedDeque()
+    final = loop.run()
+    assert final.step_int == 12  # bound changes scheduling, not results
+    assert observed and max(observed) <= 2
+    assert loop.runahead_wait_s >= 0.0
+    assert not loop._inflight  # drained in finally
+
+
+def test_input_pipeline_hook_reports(small_mnist, mesh8):
+    class _BatchRecWriter:
+        def __init__(self):
+            self.rows = []
+
+        def scalar(self, tag, value, step):
+            self.rows.append((step, {tag: value}))
+
+        def scalars(self, values, step):
+            self.rows.append((step, dict(values)))
+
+    writer = _BatchRecWriter()
+    step = _RecordingFlakyStep()
+    batches = DevicePrefetcher(
+        ShardedBatcher(small_mnist, 512, mesh8, seed=0), depth=2)
+    loop = TrainLoop(step, _loop_state(), batches,
+                     [InputPipelineHook(writer, every_steps=4),
+                      StopAtStepHook(last_step=8)],
+                     runahead=1)
+    loop.run()
+
+    assert writer.rows, "hook wrote nothing at its cadence"
+    steps = [s for s, _ in writer.rows]
+    assert steps == [4, 8]
+    for _, vals in writer.rows:
+        assert "input/feed_stall_ms_per_step" in vals
+        assert "input/runahead_wait_ms_per_step" in vals
+        assert "input/prefetch_occupancy" in vals
+        assert "input/h2d_mbytes_per_step" in vals
+        assert vals["input/h2d_mbytes_per_step"] > 0
+    assert loop.hooks[0].last  # bench harness handle
+    assert _wait_drained()
+
+
+def test_shared_stats_object_survives_reseek(small_mnist, mesh8):
+    stats = PrefetchStats(depth=2)
+    pf = DevicePrefetcher(ShardedBatcher(small_mnist, 512, mesh8),
+                          depth=2, stats=stats)
+    _take(pf, 3)
+    _take(pf.at_step(4), 2)
+    assert pf.stats()["batches"] == 5
