@@ -62,6 +62,10 @@ flags.DEFINE_integer("host_device_count", None,
 flags.DEFINE_integer("num_processes", 1, "total processes (multi-host)")
 flags.DEFINE_integer("process_id", 0, "this process's index")
 flags.DEFINE_boolean("profile", False, "trace a window of steps to logdir")
+flags.DEFINE_string("sharding", None,
+                    "sharding strategy override: dp | tp | fsdp (ZeRO-style "
+                    "params+opt-state over the data axis) | fsdp_tp "
+                    "(parallel/sharding.py resolve_rules; None = config)")
 flags.DEFINE_string("prng_impl", None,
                     "PRNG impl override: threefry2x32 (default) | rbg "
                     "(faster dropout masks on TPU; see configs.py)")
@@ -284,6 +288,7 @@ def _run_config(
             hooks_lib.InputPipelineHook(writer, every_steps=cfg.log_every),
             hooks_lib.LoggingHook(every_steps=cfg.log_every),
             hooks_lib.SummaryHook(writer, every_steps=cfg.log_every),
+            hooks_lib.MemoryHook(writer, every_steps=cfg.log_every),
             hooks_lib.NaNGuardHook(),
         ]
         eval_hook = None
@@ -376,6 +381,13 @@ def _apply_flag_overrides(cfg):
         over["mesh"] = MeshSpec(**{k: int(v) for k, v in kv.items()})
     if FLAGS.prng_impl:
         over["prng_impl"] = FLAGS.prng_impl
+    if FLAGS.sharding:
+        # validate EAGERLY (same rationale as remat_policy below): a typo'd
+        # strategy must fail here, not silently train under the config's
+        from dist_mnist_tpu.parallel.sharding import resolve_rules
+
+        resolve_rules(FLAGS.sharding)
+        over["sharding_rules"] = FLAGS.sharding
     if FLAGS.remat_policy:
         # validate EAGERLY: resolve_remat_policy otherwise only runs when
         # remat=True, so a typo'd policy on a non-remat config would pass

@@ -35,7 +35,9 @@ class Config:
     warmup_steps: int = 0
     replicas_to_aggregate: int = 1  # >1 => gradient accumulation (optim/sync.py)
     sharding_rules: str = "dp"  # "dp" (params replicated) | "tp" (Megatron
-    # column/row TP_RULES over the `model` axis — parallel/sharding.py)
+    # column/row TP_RULES over the `model` axis) | "fsdp" (ZeRO-style:
+    # params + optimizer slots sharded over `data`, 1/data-th per device)
+    # | "fsdp_tp" (both composed) — parallel/sharding.py
     grad_clip_norm: float | None = None
     weight_decay: float = 0.0
     prng_impl: str = "threefry2x32"  # | "rbg": hardware-friendly PRNG —
@@ -100,6 +102,25 @@ CONFIGS = {
         warmup_steps=200,
         grad_clip_norm=1.0,
         augment=True,  # pad-crop-flip: standard CIFAR recipe, on device
+        mesh=MeshSpec(data=8),
+        ladder_devices=8,
+    ),
+    # 4b) config 4 under ZeRO/FSDP: same model, data, and trajectory as
+    # resnet20_cifar (the sharding is numerics-neutral), but params + Adam
+    # slots live 1/8th per chip — the bench-ladder rung that measures the
+    # HBM claim (`bench.py --memory` dp vs fsdp).
+    "resnet20_cifar_fsdp": Config(
+        name="resnet20_cifar_fsdp",
+        model="resnet20",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=2e-3,
+        lr_schedule="cosine",
+        warmup_steps=200,
+        grad_clip_norm=1.0,
+        augment=True,
+        sharding_rules="fsdp",
         mesh=MeshSpec(data=8),
         ladder_devices=8,
     ),
@@ -203,6 +224,28 @@ CONFIGS = {
         augment=True,
         model_kwargs={"scan_blocks": True},
         sharding_rules="tp",
+        mesh=MeshSpec(data=-1, model=2),
+        ladder_devices=16,
+    ),
+    # 5e') config 5e with FSDP composed on top of TP: the `model` axis
+    # takes the Megatron column/row split first, the FSDP shape rule then
+    # shards each leaf's largest remaining free dim over `data` — params +
+    # slots are 1/(data*model)-th per chip where both apply.
+    "vit_tiny_cifar_fsdp_tp": Config(
+        name="vit_tiny_cifar_fsdp_tp",
+        model="vit_tiny",
+        dataset="cifar10",
+        batch_size=1024,
+        train_steps=5000,
+        learning_rate=1e-3,
+        lr_schedule="cosine",
+        warmup_steps=500,
+        grad_clip_norm=1.0,
+        weight_decay=0.05,
+        remat=True,
+        augment=True,
+        model_kwargs={"scan_blocks": True},
+        sharding_rules="fsdp_tp",
         mesh=MeshSpec(data=-1, model=2),
         ladder_devices=16,
     ),

@@ -21,6 +21,7 @@ from dist_mnist_tpu.hooks.builtin import (
     GlobalStepWaiterHook,
     FinalOpsHook,
     MemoryProfileHook,
+    MemoryHook,
 )
 
 __all__ = [
@@ -38,4 +39,5 @@ __all__ = [
     "GlobalStepWaiterHook",
     "FinalOpsHook",
     "MemoryProfileHook",
+    "MemoryHook",
 ]

@@ -168,8 +168,8 @@ class LoggingHook(Hook):
         # blocking sync per metric per cadence, serializing dispatch
         wanted = {k: outputs[k] for k in keys
                   if k in outputs and getattr(outputs[k], "size", 1) == 1}
-        vals = jax.device_get(wanted)
-        parts = [f"{k}={float(v):.4f}" for k, v in vals.items()]
+        vals = jax.device_get(wanted)  # host-sync-ok: one batched fetch per cadence
+        parts = [f"{k}={float(v):.4f}" for k, v in vals.items()]  # host-sync-ok: numpy scalars post-fetch
         log.info("step %d: %s", step, ", ".join(parts))
 
 
@@ -196,7 +196,7 @@ class NaNGuardHook(Hook):
         self._timer.mark()
         # explicit single fetch (float() on a device scalar is an implicit
         # blocking sync; keep the sync surface to one call per cadence)
-        val = float(jax.device_get(outputs[self._key]))
+        val = float(jax.device_get(outputs[self._key]))  # host-sync-ok: one scalar per cadence, NaN check NEEDS the value
         if math.isfinite(val):
             return
         if self._fail:
@@ -264,14 +264,25 @@ class SummaryHook(Hook):
         if not self._timer.should_trigger(step):
             return
         self._timer.mark()
-        for k, v in outputs.items():
+        # ONE device_get for the whole cadence — histograms AND scalars.
+        # The per-key `float(v)` here was one blocking sync per metric per
+        # cadence (the same serialized-dispatch bug LoggingHook fixed).
+        fetched = jax.device_get(dict(outputs))  # host-sync-ok: one batched fetch per cadence
+        vals = {}
+        for k, v in fetched.items():
             if getattr(v, "size", 1) > 1:
-                self._write_histogram(k, jax.device_get(v), step)
+                self._write_histogram(k, v, step)
                 continue
             try:
-                self._writer.scalar(k, float(v), step)
+                vals[k] = float(v)  # host-sync-ok: numpy scalar post-fetch
             except (TypeError, ValueError):
                 pass
+        batch_write = getattr(self._writer, "scalars", None)
+        if callable(batch_write):
+            batch_write(vals, step)
+        else:
+            for k, v in vals.items():
+                self._writer.scalar(k, v, step)
 
     def _write_histogram(self, tag, values, step):
         if hasattr(self._writer, "histogram"):
@@ -288,10 +299,11 @@ class SummaryHook(Hook):
         from dist_mnist_tpu.parallel.sharding import _paths
 
         flat, _, paths = _paths(state.params)
-        for path, (_, leaf) in zip(paths, flat):
-            if getattr(leaf, "size", 0):
-                self._write_histogram(f"params/{path}",
-                                      jax.device_get(leaf), step)
+        wanted = {p: leaf for p, (_, leaf) in zip(paths, flat)
+                  if getattr(leaf, "size", 0)}
+        fetched = jax.device_get(wanted)  # host-sync-ok: one batched pull per (slow) param-histogram cadence
+        for path, vals in fetched.items():
+            self._write_histogram(f"params/{path}", vals, step)
 
     def end(self, state):
         self._writer.flush()
@@ -404,6 +416,80 @@ class MemoryProfileHook(Hook):
         if self._at is not None:
             self._at = None
             self._dump(f"{self._logdir}/memory-final.prof")
+
+
+class MemoryHook(Hook):
+    """Per-device HBM attribution through the obs writers — the hook face
+    of `bench.py --memory`. No reference counterpart: the PS design spread
+    state across hosts' RAM; under SPMD the scarce resource is device HBM
+    and WHERE the bytes live (replicated vs 1/data-th under `fsdp`) is a
+    placement decision this hook makes observable.
+
+    At `begin` it writes the resident-state attribution computed from
+    shard shapes (train/state.state_memory_bytes — pure metadata, no
+    transfer):
+
+      memory/param_bytes_per_device        master weights
+      memory/opt_state_bytes_per_device    Adam m/v + counters
+      memory/model_state_bytes_per_device  BN stats etc.
+      memory/total_bytes_per_device
+
+    and at its cadence, live allocator stats when the backend exposes
+    them (`device.memory_stats()` — TPU yes, CPU no):
+
+      memory/bytes_in_use
+      memory/peak_bytes_in_use
+
+    `last` keeps the newest values for bench harnesses."""
+
+    def __init__(self, writer=None, every_steps: int = 100):
+        self._writer = writer
+        self._timer = EverySteps(every_steps=every_steps)
+        self.last: dict[str, float] = {}
+
+    def begin(self, loop):
+        from dist_mnist_tpu.train.state import state_memory_bytes
+
+        self._timer.prime(loop.initial_step)
+        vals = {f"memory/{k}_per_device": v
+                for k, v in state_memory_bytes(loop.state).items()}
+        log.info(
+            "resident state per device: params %.2f MiB, opt state %.2f "
+            "MiB, model state %.2f MiB",
+            vals["memory/param_bytes_per_device"] / 2**20,
+            vals["memory/opt_state_bytes_per_device"] / 2**20,
+            vals["memory/model_state_bytes_per_device"] / 2**20,
+        )
+        self._emit(vals, loop.initial_step)
+
+    def _live_stats(self) -> dict:
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 — backends without allocator stats
+            return {}
+        if not stats:
+            return {}
+        return {f"memory/{k}": stats[k]
+                for k in ("bytes_in_use", "peak_bytes_in_use") if k in stats}
+
+    def _emit(self, vals, step):
+        self.last.update(vals)
+        if self._writer is None:
+            return
+        batch_write = getattr(self._writer, "scalars", None)
+        if callable(batch_write):
+            batch_write(vals, step)
+        else:
+            for k, v in vals.items():
+                self._writer.scalar(k, v, step)
+
+    def after_step(self, step, state, outputs):
+        if not self._timer.should_trigger(step):
+            return
+        self._timer.mark()
+        vals = self._live_stats()
+        if vals:
+            self._emit(vals, step)
 
 
 class GlobalStepWaiterHook(Hook):

@@ -7,7 +7,8 @@ PartitionSpec per array instead of replica_device_setter's round-robin
 (§2.2 row 5), and every byte that crossed gRPC per step becomes an XLA
 collective over ICI compiled into the step program.
 
-- `sharding.py` — param/batch PartitionSpec rules per mesh axis (DP/TP).
+- `sharding.py` — param/batch PartitionSpec rules per mesh axis
+  (DP/TP/FSDP — ZeRO-style param+opt-state sharding over `data`).
 - `collectives.py` — thin named wrappers over lax collectives + shard_map
   helpers for the explicit-SPMD path.
 - `ring_attention.py` — sequence-parallel ring attention (ppermute K/V).
@@ -23,6 +24,9 @@ from dist_mnist_tpu.parallel.sharding import (
     ShardingRules,
     DP_RULES,
     TP_RULES,
+    FSDP_RULES,
+    FSDP_TP_RULES,
+    derive_state_specs,
     shard_train_state,
     params_sharding,
     tree_sharding,
@@ -32,6 +36,9 @@ __all__ = [
     "ShardingRules",
     "DP_RULES",
     "TP_RULES",
+    "FSDP_RULES",
+    "FSDP_TP_RULES",
+    "derive_state_specs",
     "shard_train_state",
     "params_sharding",
     "tree_sharding",

@@ -13,18 +13,30 @@ import dataclasses
 import re
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dist_mnist_tpu.cluster.mesh import MODEL_AXIS
+from dist_mnist_tpu.cluster.mesh import DATA_AXIS, MODEL_AXIS
 
 
 @dataclasses.dataclass(frozen=True)
 class ShardingRules:
     """Ordered (regex, spec-maker) rules; first match wins, default
     replicated. The spec maker receives the array's ndim so a rule can place
-    an axis relative to the end (e.g. "last dim over model")."""
+    an axis relative to the end (e.g. "last dim over model").
+
+    `fsdp_axis` adds a SHAPE-based rule on top of the regex rules (ZeRO-1 /
+    FSDP, the TPU-native revival of the reference's `replica_device_setter`
+    partitioning): every float leaf additionally shards its LARGEST axis
+    that (a) is divisible by the mesh's `fsdp_axis` size and (b) the regex
+    spec left free — so it composes with TP (a `qkv/w` already P(None,
+    "model") becomes P("data", "model")). Leaves with no such axis (small
+    biases, scalars) stay on their regex spec. GSPMD then inserts the
+    all-gather on use and the reduce-scatter on the matching grads; the
+    resident copy in HBM is 1/axis-size per device."""
 
     rules: tuple[tuple[str, tuple], ...] = ()
+    fsdp_axis: str | None = None
 
     def spec_for(self, path: str, ndim: int) -> P:
         for pattern, axes in self.rules:
@@ -35,15 +47,63 @@ class ShardingRules:
                 return P(*(pad + tuple(axes)))
         return P()  # replicated
 
-    def match_count(self, tree) -> int:
-        """How many leaves of `tree` any rule matches (0 on an empty rule
-        set). A non-empty rule set matching NOTHING means the named strategy
-        silently degrades to replication — callers should refuse."""
-        _, _, paths = _paths(tree)
-        return sum(
-            1 for p in paths
-            if any(re.search(pattern, p) for pattern, _ in self.rules)
+    def leaf_spec(self, path: str, leaf, mesh: Mesh) -> P:
+        """Full per-leaf placement: regex spec, then the FSDP shape rule."""
+        spec = self.spec_for(path, getattr(leaf, "ndim", 0))
+        if self.fsdp_axis:
+            spec = _fsdp_compose(
+                spec, leaf, mesh.shape[self.fsdp_axis], self.fsdp_axis
+            )
+        return spec
+
+    def _fsdp_shards(self, leaf, mesh: Mesh | None, base: P) -> bool:
+        """Would the FSDP shape rule shard `leaf` beyond its regex spec?"""
+        if not self.fsdp_axis or mesh is None:
+            return False
+        return (
+            _fsdp_compose(base, leaf, mesh.shape[self.fsdp_axis],
+                          self.fsdp_axis)
+            != base
         )
+
+    def match_count(self, tree, mesh: Mesh | None = None) -> int:
+        """How many leaves of `tree` this strategy actually places (0 on an
+        empty rule set). A non-empty strategy matching NOTHING means it
+        silently degrades to replication — callers should refuse. The FSDP
+        shape rule needs the `mesh` to decide divisibility; without one only
+        the regex rules are counted."""
+        flat, _, paths = _paths(tree)
+        n = 0
+        for p, (_, v) in zip(paths, flat):
+            if any(re.search(pattern, p) for pattern, _ in self.rules):
+                n += 1
+            elif self._fsdp_shards(v, mesh, self.spec_for(
+                    p, getattr(v, "ndim", 0))):
+                n += 1
+        return n
+
+
+def _fsdp_compose(spec: P, leaf, axis_size: int, axis_name: str) -> P:
+    """`spec` with `axis_name` added on the largest free divisible dim of
+    `leaf`, or `spec` unchanged when no dim qualifies. Float arrays only:
+    params and optimizer slots are what ZeRO shards — uint8 batches, int
+    counters, and PRNG keys must never be split by a shape heuristic."""
+    shape = getattr(leaf, "shape", ())
+    dtype = getattr(leaf, "dtype", None)
+    if not shape or dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+        return spec
+    entries = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    if axis_name in entries:  # already placed by a regex rule
+        return spec
+    best = -1
+    for i, (dim, taken) in enumerate(zip(shape, entries)):
+        if taken is None and dim % axis_size == 0 and dim > 1:
+            if best < 0 or dim > shape[best]:
+                best = i
+    if best < 0:
+        return spec
+    entries = entries[:best] + (axis_name,) + entries[best + 1:]
+    return P(*entries)
 
 
 # Pure data parallelism: every param replicated.
@@ -61,31 +121,118 @@ TP_RULES = ShardingRules(
     )
 )
 
+# ZeRO-1/FSDP: params + optimizer slots sharded over `data` (the shape rule
+# — each leaf's largest divisible free axis), batch sharding unchanged. The
+# SPMD revival of the reference's PS partitioning: `replica_device_setter`
+# round-robined Variables AND their Adam slots across ps tasks
+# (device_setter.py:92-125); here the same state is 1/data-th per chip and
+# GSPMD inserts the gather/scatter the PS protocol did over gRPC.
+FSDP_RULES = ShardingRules(fsdp_axis=DATA_AXIS)
+
+# FSDP composed with Megatron TP: regex rules place the `model` axis first,
+# the shape rule adds `data` on the largest remaining free dim.
+FSDP_TP_RULES = ShardingRules(rules=TP_RULES.rules, fsdp_axis=DATA_AXIS)
+
 
 def resolve_rules(name: str) -> ShardingRules:
     """Config-string -> rules (`Config.sharding_rules`). One definition so
     every driver (cli/train.py, bench.py) benchmarks/trains the SAME
     strategy a config names — a driver that forgot to thread this through
     would silently run DP under a TP config's name."""
-    table = {"dp": DP_RULES, "tp": TP_RULES}
+    table = {"dp": DP_RULES, "tp": TP_RULES, "fsdp": FSDP_RULES,
+             "fsdp_tp": FSDP_TP_RULES}
     if name not in table:
-        raise ValueError(f"unknown sharding_rules {name!r}; use 'dp' | 'tp'")
+        raise ValueError(
+            f"unknown sharding_rules {name!r}; use 'dp' | 'tp' | 'fsdp' | "
+            "'fsdp_tp'"
+        )
     return table[name]
+
+
+def _key_seg(k) -> str:
+    # DictKey -> "conv1", GetAttrKey -> "params" (str() would render
+    # ".params" and break the "params/" prefix checks below),
+    # SequenceKey -> "[0]" (chain optimizer states)
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "name"):
+        return str(k.name)
+    if hasattr(k, "idx"):
+        return f"[{k.idx}]"
+    return str(k)
 
 
 def _paths(tree):
     # tree_util spelling: `jax.tree.flatten_with_path` only exists on
     # jax>=0.5, and this is the same function there
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    paths = ["/".join(str(getattr(k, "key", k)) for k in path) for path, _ in flat]
+    paths = ["/".join(_key_seg(k) for k in path) for path, _ in flat]
     return flat, treedef, paths
 
 
+def derive_state_specs(state, mesh: Mesh, rules: ShardingRules):
+    """PartitionSpec pytree for a TrainState (anything with `.params` and
+    `.opt_state`): params place by `rules` (regex + FSDP shape rule), and
+    every OPTIMIZER-STATE leaf INHERITS the spec of the param it mirrors —
+    matched by path suffix + shape — instead of defaulting to replicated.
+
+    That inheritance is the derived-spec contract: Adam's m/v, AdamW's
+    inner slots, the accumulation buffer (`acc/...`), and chained states
+    (`[i]/m/...`) all structurally mirror the param tree, so the colocation
+    the reference got from slot-colocated-with-variable on the PS
+    (adam.py:189-203) holds under any rule set — including the shape-based
+    FSDP rule, where a regex over slot paths could never see shapes.
+    Non-mirroring opt leaves (step counters) and everything outside
+    params/opt_state (model_state, step, rng) stay on the regex rules
+    alone; the FSDP shape rule never touches them (BN statistics are
+    updated by the forward pass — sharding them would change what a
+    device computes, not just where bytes live)."""
+    param_flat, _, param_paths = _paths(state.params)
+    # longest-suffix match first: a bare "w" param must not shadow "x/w"
+    by_len = sorted(
+        zip(param_paths, param_flat), key=lambda kv: -len(kv[0])
+    )
+    param_specs = {
+        p: rules.leaf_spec(p, v, mesh) for p, (_, v) in zip(
+            param_paths, param_flat)
+    }
+
+    def inherited(path, leaf):
+        shape = getattr(leaf, "shape", None)
+        for ppath, (_, pleaf) in by_len:
+            if (path.endswith("/" + ppath)
+                    and getattr(pleaf, "shape", ()) == shape):
+                return param_specs[ppath]
+        return None
+
+    flat, treedef, paths = _paths(state)
+    specs = []
+    for path, (_, leaf) in zip(paths, flat):
+        if path.startswith("params/"):
+            spec = rules.leaf_spec(path[len("params/"):], leaf, mesh)
+        elif path.startswith("opt_state/"):
+            spec = inherited(path, leaf)
+            if spec is None:
+                spec = rules.spec_for(path, getattr(leaf, "ndim", 0))
+        else:
+            spec = rules.spec_for(path, getattr(leaf, "ndim", 0))
+        specs.append(spec)
+    return jax.tree.unflatten(treedef, specs)
+
+
 def tree_sharding(tree, mesh: Mesh, rules: ShardingRules):
-    """Matching pytree of NamedShardings for `tree` under `rules`."""
+    """Matching pytree of NamedShardings for `tree` under `rules`.
+
+    A TrainState-shaped tree (has `.params`/`.opt_state`) goes through
+    `derive_state_specs` so optimizer slots inherit their param's spec;
+    any other pytree places each leaf independently by `leaf_spec`."""
+    if hasattr(tree, "params") and hasattr(tree, "opt_state"):
+        specs = derive_state_specs(tree, mesh, rules)
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
     flat, treedef, paths = _paths(tree)
     shardings = [
-        NamedSharding(mesh, rules.spec_for(p, getattr(v, "ndim", 0)))
+        NamedSharding(mesh, rules.leaf_spec(p, v, mesh))
         for p, (_, v) in zip(paths, flat)
     ]
     return jax.tree.unflatten(treedef, shardings)
@@ -105,12 +252,16 @@ def shard_train_state(state, mesh: Mesh, rules: ShardingRules = DP_RULES):
 
     Refuses a non-trivial rule set that matches NO parameter: that is the
     silent-wrong-strategy failure `resolve_rules` exists to prevent (a
-    `sharding_rules="tp"` config over a conv model would otherwise train
-    fully replicated under TP's name).
+    `sharding_rules="tp"` config over a conv model — or `fsdp` over a model
+    none of whose param dims divide the data axis — would otherwise train
+    fully replicated under the strategy's name).
     """
-    if rules.rules and rules.match_count(state.params) == 0:
+    if (rules.rules or rules.fsdp_axis) and \
+            rules.match_count(state.params, mesh) == 0:
+        what = (tuple(p for p, _ in rules.rules)
+                or f"fsdp over axis {rules.fsdp_axis!r}")
         raise ValueError(
-            f"sharding rules {tuple(p for p, _ in rules.rules)} matched no "
+            f"sharding rules {what} matched no "
             "parameter path — the model would silently train fully "
             "replicated (DP) under this strategy's name. Pick rules that "
             "match this model's params, or use DP_RULES explicitly."

@@ -27,7 +27,45 @@ class TrainState:
 
     @property
     def step_int(self) -> int:
+        # every caller is a cold path (checkpoint save, restore seek, log)
+        # host-sync-ok: one explicit scalar fetch on those cold paths
         return int(jax.device_get(self.step))
+
+
+def _per_device_nbytes(leaf) -> int:
+    """Bytes ONE device holds for `leaf` — its shard, not the global array.
+
+    Computed from `sharding.shard_shape` (pure metadata: no transfer, no
+    sync), so it is exact for any placement: a replicated leaf costs its
+    full nbytes per device, an FSDP leaf 1/axis-size of it."""
+    if not isinstance(leaf, jax.Array):
+        return 0
+    try:
+        shard_shape = leaf.sharding.shard_shape(leaf.shape)
+    except Exception:  # committed-elsewhere / abstract: fall back to global
+        shard_shape = leaf.shape
+    n = 1
+    for d in shard_shape:
+        n *= d
+    return n * leaf.dtype.itemsize
+
+
+def state_memory_bytes(state: TrainState) -> dict:
+    """Per-device resident-state HBM attribution (the `bench.py --memory` /
+    MemoryHook number): bytes one device holds for params, optimizer slots,
+    and model_state under the state's ACTUAL shardings. This is the
+    quantity ZeRO/FSDP shrinks — under `dp` every chip holds full replicas
+    (params + 2x Adam slots), under `fsdp` 1/data-th of each sharded leaf."""
+    out = {
+        "param_bytes": sum(_per_device_nbytes(x)
+                           for x in jax.tree.leaves(state.params)),
+        "opt_state_bytes": sum(_per_device_nbytes(x)
+                               for x in jax.tree.leaves(state.opt_state)),
+        "model_state_bytes": sum(_per_device_nbytes(x)
+                                 for x in jax.tree.leaves(state.model_state)),
+    }
+    out["total_bytes"] = sum(out.values())
+    return out
 
 
 def create_train_state(model, optimizer, rng: jax.Array, sample_input) -> TrainState:

@@ -239,7 +239,36 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None):
             ca = ca[0] if ca else None
         return ca
 
+    def memory_analysis(state, *rest):
+        """XLA's compiled-memory analysis for ONE invocation — per-device
+        peak / argument / output / temp bytes, the HBM side of the
+        attribution story (`bench.py --memory`). Same lower+compile-only
+        contract as `cost_analysis`: never executes, safe before the first
+        donated call, None when the backend doesn't report it."""
+        _ensure_jit(state)
+        try:
+            return compiled["fn"].lower(
+                state, *_args(rest)
+            ).compile().memory_analysis()
+        except Exception:  # noqa: BLE001 — metrics aid, never fail a run
+            return None
+
+    def compiled_text(state, *rest):
+        """Compiled HLO text of the step (post-GSPMD), for tests that
+        assert WHICH collectives the partitioner inserted (e.g. fsdp must
+        show an all-gather on param use; dp must not). None when the
+        backend can't render it."""
+        _ensure_jit(state)
+        try:
+            return compiled["fn"].lower(
+                state, *_args(rest)
+            ).compile().as_text()
+        except Exception:  # noqa: BLE001
+            return None
+
     wrapper.cost_analysis = cost_analysis
+    wrapper.memory_analysis = memory_analysis
+    wrapper.compiled_text = compiled_text
     return wrapper
 
 
@@ -338,10 +367,18 @@ def make_scanned_train_fn(
 
 def make_eval_step(model, mesh: Mesh):
     """`eval_step(state, batch) -> (sum_loss, correct_count, n)` — summable
-    partial results so full-test-set eval streams in fixed-size batches."""
+    partial results so full-test-set eval streams in fixed-size batches.
 
-    @jax.jit
-    def eval_step(state: TrainState, batch):
+    Lazily jitted against `mesh`: state in_shardings are read off the LIVE
+    state's own placements on the first call, and the batch is pinned to
+    the mesh's `data` sharding. A bare `@jax.jit` here silently RESHARDED
+    a TP/FSDP-sharded state to replicated for eval — an all-gather of
+    params+slots per eval batch, defeating resident sharding exactly when
+    memory headroom matters."""
+
+    compiled: dict = {}
+
+    def _eval_core(state: TrainState, batch):
         x = batch["image"].astype(jnp.float32) / 255.0
         y = batch["label"]
         logits, _ = model.apply(state.params, state.model_state, x, train=False)
@@ -353,6 +390,22 @@ def make_eval_step(model, mesh: Mesh):
         n = jnp.sum((y >= 0).astype(jnp.int32))
         return loss_sum, correct, n
 
+    def eval_step(state: TrainState, batch):
+        if "fn" not in compiled:
+            state_shd = jax.tree.map(
+                lambda x: getattr(x, "sharding", None), state
+            )
+            batch_shd = {"image": batch_sharding(mesh),
+                         "label": batch_sharding(mesh)}
+            compiled["shardings"] = (state_shd, batch_shd)
+            compiled["fn"] = jax.jit(
+                _eval_core, in_shardings=(state_shd, batch_shd)
+            )
+        return compiled["fn"](state, batch)
+
+    # For tests: the (state, batch) in_shardings captured at first call,
+    # or None before it.
+    eval_step.captured_shardings = lambda: compiled.get("shardings")
     return eval_step
 
 
@@ -390,9 +443,10 @@ def evaluate(eval_step, state, images, labels, mesh: Mesh, batch_size: int = 100
         totals = part if totals is None else tuple(
             t + p for t, p in zip(totals, part)
         )
+    # host-sync-ok: the ONE batched end-of-eval fetch the docstring promises
     total_loss, total_correct, total_n = jax.device_get(totals)
     return {
-        "loss": float(total_loss) / int(total_n),
+        "loss": float(total_loss) / int(total_n),  # host-sync-ok: numpy scalar math post-fetch
         "accuracy": int(total_correct) / int(total_n),
         "n": int(total_n),
     }

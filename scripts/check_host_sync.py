@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Grep-lint for accidental host synchronization in hot-path modules.
+
+The per-step dispatch pipeline is this framework's whole perf story: a
+single stray `float(device_scalar)` / `.item()` / per-key `device_get`
+inside the train loop, the prefetch worker, or a hook's cadence path
+serializes dispatch exactly the way the reference's per-step feed_dict
+round-trip did (SURVEY.md §3.3) — and it regresses silently, because the
+numbers stay correct. This lint makes the sync surface explicit:
+
+- Scanned modules (the hot paths): ``dist_mnist_tpu/train/``,
+  ``dist_mnist_tpu/data/prefetch.py``, ``dist_mnist_tpu/hooks/builtin.py``.
+- Flagged constructs: ``float(`` and ``device_get(`` calls, and ``.item()``
+  — each an implicit (or explicit) device->host blocking transfer when its
+  operand is a device array.
+- Allowlist: a ``host-sync-ok`` comment on the same line or the line above
+  marks an INTENTIONAL sync (e.g. LoggingHook's one batched fetch per
+  cadence, evaluate()'s single end-of-eval pull). The comment is the
+  reviewable artifact: every sync in a hot path is either justified in
+  place or a lint failure.
+
+Tokenizer-based, not regex-on-lines: occurrences inside comments and
+docstrings don't count (several hot-path docstrings MENTION `float()`
+while explaining why it was removed).
+
+Exit status: 0 clean, 1 violations (printed one per line as
+``path:lineno: message``). Wired into tier-1 via
+tests/test_host_sync_lint.py.
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+import tokenize
+from pathlib import Path
+
+ALLOW_MARKER = "host-sync-ok"
+
+#: NAME tokens that, followed by "(", count as a sync call whether bare or
+#: attribute-qualified (`jax.device_get(...)`).
+ANY_NAMES = ("device_get",)
+
+#: NAME tokens that count only when BARE (not `x.float(...)`).
+BARE_NAMES = ("float",)
+
+#: NAME tokens that count only as a METHOD call: preceded by "." and
+#: followed by "(" — bare `item(` is some other function.
+METHOD_NAMES = ("item",)
+
+
+def default_targets(repo_root: Path) -> list[Path]:
+    pkg = repo_root / "dist_mnist_tpu"
+    targets = sorted((pkg / "train").glob("*.py"))
+    targets += [pkg / "data" / "prefetch.py", pkg / "hooks" / "builtin.py"]
+    return [t for t in targets if t.exists()]
+
+
+def scan_file(path: Path) -> list[tuple[int, str]]:
+    """(lineno, message) per violation in `path`."""
+    src = path.read_text()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except tokenize.TokenError as err:
+        return [(1, f"unparseable: {err}")]
+
+    # lines carrying an allowlist comment bless themselves AND the line
+    # below (marker-above style for lines that would overflow)
+    allowed: set[int] = set()
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT and ALLOW_MARKER in tok.string:
+            allowed.add(tok.start[0])
+            allowed.add(tok.start[0] + 1)
+
+    out = []
+    # meaningful tokens only: NL/INDENT/COMMENT tokens between a name and
+    # its "(" would defeat the adjacency check
+    code = [t for t in tokens
+            if t.type in (tokenize.NAME, tokenize.OP, tokenize.NUMBER,
+                          tokenize.STRING)]
+    for i, tok in enumerate(code):
+        if tok.type != tokenize.NAME:
+            continue
+        nxt = code[i + 1] if i + 1 < len(code) else None
+        if nxt is None or nxt.string != "(":
+            continue
+        prev = code[i - 1] if i > 0 else None
+        is_method = prev is not None and prev.string == "."
+        if (tok.string in ANY_NAMES
+                or tok.string in BARE_NAMES and not is_method
+                or tok.string in METHOD_NAMES and is_method):
+            if tok.start[0] in allowed:
+                continue
+            what = f".{tok.string}()" if is_method else f"{tok.string}("
+            out.append((
+                tok.start[0],
+                f"{what} in a hot-path module is a blocking device->host "
+                f"sync; batch it or annotate with `# {ALLOW_MARKER}: <why>`",
+            ))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    repo_root = Path(__file__).resolve().parent.parent
+    targets = ([Path(a) for a in argv] if argv
+               else default_targets(repo_root))
+    violations = []
+    for path in targets:
+        for lineno, msg in scan_file(path):
+            violations.append(f"{path}:{lineno}: {msg}")
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} host-sync violation(s).", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -152,3 +152,53 @@ def test_probe_still_retries_transient_errors(monkeypatch):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     errs = bench._probe(retries=3, timeout_s=1)
     assert len(attempts) == 3 and len(errs) == 3
+
+
+# ------------------------------------------------- cpu fallback (ISSUE 3) --
+
+
+def test_probe_falls_back_to_cpu_and_tags_records(monkeypatch, capsys):
+    """TPU probe down, CPU probe up: the run proceeds and EVERY emitted
+    record carries `backend: cpu-fallback` — a labeled CPU number instead
+    of no number (and never a number masquerading as on-chip)."""
+    import json
+    import os
+
+    def fake_probe(retries, timeout_s):
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            return []  # CPU probe succeeds
+        return ["RPC failed: Connection refused (ECONNREFUSED)"]
+
+    monkeypatch.setattr(bench, "_probe", fake_probe)
+    monkeypatch.setattr(bench, "_RECORD_TAGS", {})
+    monkeypatch.setenv("JAX_PLATFORMS", "")  # pretend the relay was selected
+
+    assert bench.probe_backend_with_fallback("steps_per_sec_per_chip",
+                                             retries=1) is True
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    assert bench._RECORD_TAGS == {"backend": "cpu-fallback"}
+
+    bench.emit({"metric": "steps_per_sec_per_chip", "value": 123.0})
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["backend"] == "cpu-fallback"
+    assert rec["value"] == 123.0
+
+
+def test_probe_fallback_both_down_emits_error(monkeypatch, capsys):
+    """TPU AND CPU probes down: structured error line with both probes'
+    errors, rc path returns False, and no fallback tag leaks."""
+    import json
+
+    monkeypatch.setattr(bench, "_probe",
+                        lambda r, t: ["RPC failed: Connection refused"])
+    monkeypatch.setattr(bench, "_RECORD_TAGS", {})
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+
+    assert bench.probe_backend_with_fallback("steps_per_sec_per_chip",
+                                             retries=2) is False
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["value"] == 0.0
+    assert "cpu fallback failed" in rec["error"]
+    assert rec["extra"]["probe_errors"]
+    assert "backend" not in rec  # no fallback tag on a failed run
+    assert bench._RECORD_TAGS == {}

@@ -17,10 +17,13 @@ def test_config_registry_covers_ladder():
         "vit_tiny_cifar_moe", "vit_tiny_cifar_pp", "vit_tiny_cifar_tp",
         "vit_tiny_cifar_ring", "vit_tiny_cifar_flash",
         "vit_tiny_cifar_ring_flash", "vit_tiny_cifar_ulysses_flash",
+        "resnet20_cifar_fsdp", "vit_tiny_cifar_fsdp_tp",
     }
     # every §2.6 strategy is CLI-selectable from the ladder: DP (all),
-    # TP, SP-ring, SP-ulysses, EP-moe, PP — one config each
+    # TP, SP-ring, SP-ulysses, EP-moe, PP, ZeRO-fsdp — one config each
     assert CONFIGS["vit_tiny_cifar_tp"].sharding_rules == "tp"
+    assert CONFIGS["resnet20_cifar_fsdp"].sharding_rules == "fsdp"
+    assert CONFIGS["vit_tiny_cifar_fsdp_tp"].sharding_rules == "fsdp_tp"
 
 
 @pytest.mark.slow

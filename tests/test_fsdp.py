@@ -1,0 +1,256 @@
+"""ZeRO-style FSDP sharding: specs, trajectory parity, HBM reduction,
+GSPMD collectives, resharding checkpoint restores, mesh-aware eval.
+
+The acceptance contract of the `fsdp` strategy (ISSUE 3): on the 8-device
+CPU mesh the loss trajectory matches `dp` within float tolerance, the
+per-device param+opt-state bytes shrink >= 4x, GSPMD inserts the
+param all-gather (visible in compiled HLO), and checkpoints round-trip
+sharded->sharded AND across strategies (dp<->fsdp resharding restore).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dist_mnist_tpu import optim
+from dist_mnist_tpu.data.pipeline import ShardedBatcher, batch_sharding, shard_batch
+from dist_mnist_tpu.models import get_model
+from dist_mnist_tpu.parallel.sharding import (
+    DP_RULES,
+    FSDP_RULES,
+    derive_state_specs,
+    shard_train_state,
+)
+from dist_mnist_tpu.train import create_train_state, evaluate, make_eval_step
+from dist_mnist_tpu.train.state import state_memory_bytes
+from dist_mnist_tpu.train.step import make_train_step
+
+
+def _mlp_state(mesh, rules, hidden=64, optimizer=None):
+    """MLP with FSDP-divisible dims (784 and 64 both divide 8) sharded
+    under `rules`."""
+    model = get_model("mlp", hidden_units=hidden)
+    opt = optimizer or optim.adam(1e-3)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 28, 28, 1), jnp.uint8))
+    return model, opt, shard_train_state(state, mesh, rules)
+
+
+def _params_equal(a, b) -> bool:
+    return all(bool(jnp.allclose(x, y)) for x, y in
+               zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)))
+
+
+# ---------------------------------------------------------------- specs --
+
+
+def test_opt_state_inherits_specs_through_chain_and_accumulation(mesh8):
+    """Adam slots, chained-transform slots, and the accumulation buffer
+    all mirror the param tree, so each leaf must inherit its param's
+    FSDP spec — a regex over slot paths could never see the shapes the
+    FSDP rule decides by."""
+    model = get_model("mlp", hidden_units=64)
+    opt = optim.gradient_accumulation(
+        optim.chain(optim.clip_by_global_norm(1.0), optim.adam(1e-3)), 2
+    )
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 28, 28, 1), jnp.uint8))
+    specs = derive_state_specs(state, mesh8, FSDP_RULES)
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    by_path = {
+        "/".join(str(getattr(k, "key", None) or getattr(k, "name", None)
+                     or f"[{k.idx}]") for k in path): spec
+        for path, spec in flat
+    }
+    hid_w = P("data", None)  # (784, 64): largest divisible dim is 784
+    param_like = {p: s for p, s in by_path.items() if p.endswith("hid/w")}
+    assert param_like, sorted(by_path)
+    for path, spec in param_like.items():
+        assert spec == hid_w, (path, spec)
+    # counters never shard
+    for path, spec in by_path.items():
+        if path.endswith(("count", "calls")) or path in ("step", "rng"):
+            assert spec == P(), (path, spec)
+
+
+def test_shard_train_state_places_opt_state_sharded(mesh8):
+    _, _, state = _mlp_state(mesh8, FSDP_RULES)
+    assert state.params["hid"]["w"].sharding.spec == P("data", None)
+    assert state.opt_state["m"]["hid"]["w"].sharding.spec == P("data", None)
+    assert state.opt_state["v"]["sm"]["w"].sharding.spec == P("data", None)
+    assert state.opt_state["count"].sharding.spec == P()
+    assert state.rng.sharding.spec == P()
+
+
+# ----------------------------------------------------- memory reduction --
+
+
+def test_fsdp_shrinks_per_device_state_bytes_4x(mesh8):
+    """The ZeRO claim, measured two ways: resident shard bytes (array
+    shard_shape) and XLA's compiled argument bytes must BOTH shrink
+    >= 4x vs dp on the 8-device mesh (lenet5: every big dim divides 8,
+    so the actual factor is ~8x)."""
+    model = get_model("lenet5")
+    opt = optim.adam(1e-3)
+    base = create_train_state(model, opt, jax.random.PRNGKey(0),
+                              jnp.zeros((1, 28, 28, 1), jnp.uint8))
+    img = np.zeros((64, 28, 28, 1), np.uint8)
+    lab = np.zeros((64,), np.int32)
+    measured = {}
+    for name, rules in (("dp", DP_RULES), ("fsdp", FSDP_RULES)):
+        state = shard_train_state(base, mesh8, rules)
+        mem = state_memory_bytes(state)
+        step = make_train_step(model, opt, mesh8, rules=rules, donate=False)
+        batch = shard_batch({"image": img, "label": lab}, mesh8)
+        ma = step.memory_analysis(state, batch)
+        measured[name] = {
+            "state": mem["param_bytes"] + mem["opt_state_bytes"],
+            "args": getattr(ma, "argument_size_in_bytes", None),
+        }
+    assert measured["dp"]["state"] >= 4 * measured["fsdp"]["state"]
+    if measured["dp"]["args"] and measured["fsdp"]["args"]:
+        assert measured["dp"]["args"] >= 4 * measured["fsdp"]["args"]
+
+
+# ------------------------------------------------------------ collectives --
+
+
+def test_fsdp_compiled_step_all_gathers_params(mesh8):
+    """GSPMD must implement the fsdp step as gather-on-use: the compiled
+    HLO contains an all-gather under fsdp and none under dp (dp moves
+    only grads, via all-reduce)."""
+    img = np.zeros((64, 28, 28, 1), np.uint8)
+    lab = np.zeros((64,), np.int32)
+    texts = {}
+    for name, rules in (("dp", DP_RULES), ("fsdp", FSDP_RULES)):
+        model, opt, state = _mlp_state(mesh8, rules)
+        step = make_train_step(model, opt, mesh8, rules=rules, donate=False)
+        batch = shard_batch({"image": img, "label": lab}, mesh8)
+        texts[name] = step.compiled_text(state, batch)
+    if texts["dp"] is None or texts["fsdp"] is None:
+        pytest.skip("backend cannot render compiled HLO text")
+    assert "all-gather" in texts["fsdp"]
+    assert "all-gather" not in texts["dp"]
+    # grads still reduce in both ("all-reduce", or fused "reduce-scatter")
+    assert ("all-reduce" in texts["fsdp"]) or ("reduce-scatter" in texts["fsdp"])
+    assert "all-reduce" in texts["dp"]
+
+
+# ------------------------------------------------------------- trajectory --
+
+
+def test_fsdp_matches_dp_trajectory_two_epochs(mesh8, small_mnist):
+    """Same seed, same batch stream, two full epochs: fsdp only changes
+    WHERE bytes live, so the loss trajectory must match dp within float
+    tolerance."""
+    batch_size = 512
+    steps_per_epoch = len(small_mnist.train_labels) // batch_size
+    n_steps = 2 * steps_per_epoch
+    assert n_steps >= 8
+    traj = {}
+    for name, rules in (("dp", DP_RULES), ("fsdp", FSDP_RULES)):
+        model, opt, state = _mlp_state(mesh8, rules)
+        step = make_train_step(model, opt, mesh8, rules=rules)
+        batches = iter(ShardedBatcher(small_mnist, batch_size, mesh8, seed=0))
+        losses = []
+        for _ in range(n_steps):
+            state, out = step(state, next(batches))
+            losses.append(out["loss"])
+        traj[name] = np.asarray(jax.device_get(losses), np.float64)
+    np.testing.assert_allclose(traj["fsdp"], traj["dp"], rtol=1e-5, atol=1e-6)
+    assert traj["dp"][-1] < traj["dp"][0]  # it actually trained
+
+
+# ------------------------------------------------------------- checkpoint --
+
+
+@pytest.mark.parametrize("src_name,dst_name", [
+    ("fsdp", "fsdp"),  # sharded -> sharded
+    ("dp", "fsdp"),    # resharding restore (the upgrade path)
+    ("fsdp", "dp"),    # and back
+])
+def test_checkpoint_roundtrip_across_strategies(tmp_path, mesh8,
+                                                src_name, dst_name):
+    from dist_mnist_tpu.checkpoint import CheckpointManager
+
+    rules = {"dp": DP_RULES, "fsdp": FSDP_RULES}
+    model, opt, src = _mlp_state(mesh8, rules[src_name])
+    src = dataclasses.replace(src, step=jnp.asarray(7, jnp.int32))
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    try:
+        assert mgr.save(src)
+        mgr.wait()
+        # a DIFFERENT init as the target proves values came from disk
+        target = shard_train_state(
+            create_train_state(model, opt, jax.random.PRNGKey(9),
+                               jnp.zeros((1, 28, 28, 1), jnp.uint8)),
+            mesh8, rules[dst_name])
+        restored = mgr.restore(target)
+    finally:
+        mgr.close()
+    assert restored.step_int == 7
+    assert _params_equal(restored, src)
+    # restored leaves carry the TARGET's (not the checkpoint's) shardings
+    want = P("data", None) if dst_name == "fsdp" else P()
+    assert restored.params["hid"]["w"].sharding.spec == want
+    assert restored.opt_state["m"]["hid"]["w"].sharding.spec == want
+
+
+# ------------------------------------------------------------------ eval --
+
+
+def test_eval_step_derives_shardings_from_mesh_and_state(mesh8, small_mnist):
+    """Satellite: make_eval_step must pin its in_shardings to the live
+    state's placements + the mesh's batch sharding — a bare @jax.jit
+    resharded an FSDP state to replicated for every eval batch."""
+    model, opt, state = _mlp_state(mesh8, FSDP_RULES)
+    eval_step = make_eval_step(model, mesh8)
+    assert eval_step.captured_shardings() is None  # lazy until first call
+    res = evaluate(eval_step, state, small_mnist.test_images,
+                   small_mnist.test_labels, mesh8)
+    state_shd, batch_shd = eval_step.captured_shardings()
+    assert state_shd.params["hid"]["w"].spec == P("data", None)
+    assert state_shd.opt_state["m"]["hid"]["w"].spec == P("data", None)
+    assert batch_shd["image"] == batch_sharding(mesh8)
+    assert batch_shd["label"] == batch_sharding(mesh8)
+    # numerics: same state evaluated under dp placement agrees exactly
+    model_dp, _, state_dp = _mlp_state(mesh8, DP_RULES)
+    res_dp = evaluate(make_eval_step(model_dp, mesh8), state_dp,
+                      small_mnist.test_images, small_mnist.test_labels, mesh8)
+    assert res["n"] == res_dp["n"] == len(small_mnist.test_labels)
+    np.testing.assert_allclose(res["loss"], res_dp["loss"], rtol=1e-6)
+    np.testing.assert_allclose(res["accuracy"], res_dp["accuracy"], rtol=1e-6)
+
+
+# ------------------------------------------------------------------ hook --
+
+
+def test_memory_hook_reports_sharded_state(mesh8):
+    from dist_mnist_tpu.hooks import MemoryHook
+
+    class _Writer:
+        def __init__(self):
+            self.rows = []
+
+        def scalars(self, vals, step):
+            self.rows.append((dict(vals), step))
+
+    class _Loop:
+        initial_step = 0
+
+    _, _, state = _mlp_state(mesh8, FSDP_RULES)
+    _Loop.state = state
+    writer = _Writer()
+    hook = MemoryHook(writer, every_steps=10)
+    hook.begin(_Loop())
+    (vals, step), = writer.rows
+    assert step == 0
+    mem = state_memory_bytes(state)
+    assert vals["memory/param_bytes_per_device"] == mem["param_bytes"]
+    assert vals["memory/opt_state_bytes_per_device"] == mem["opt_state_bytes"]
+    assert hook.last["memory/total_bytes_per_device"] == mem["total_bytes"]

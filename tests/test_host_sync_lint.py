@@ -1,0 +1,109 @@
+"""Tier-1 wiring for scripts/check_host_sync.py (ISSUE 3 satellite).
+
+Running the lint as a test makes the hot-path sync surface a CI invariant:
+a stray `float(device_scalar)` / `.item()` / per-key `device_get` in
+train/, data/prefetch.py, or hooks/builtin.py fails the suite unless it
+carries a reviewable `# host-sync-ok: <why>` annotation.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+SCRIPT = REPO_ROOT / "scripts" / "check_host_sync.py"
+
+
+@pytest.fixture(scope="module")
+def lint():
+    spec = importlib.util.spec_from_file_location("check_host_sync", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_host_sync", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_hot_paths_are_clean(lint, capsys):
+    """THE gate: the shipped hot-path modules carry no unannotated syncs."""
+    targets = lint.default_targets(REPO_ROOT)
+    assert targets, "lint found no hot-path modules — wiring broke"
+    names = {t.name for t in targets}
+    assert {"step.py", "state.py", "prefetch.py", "builtin.py"} <= names
+    rc = lint.main([])
+    out = capsys.readouterr()
+    assert rc == 0, f"host-sync violations in hot paths:\n{out.out}"
+
+
+def test_detects_each_sync_construct(lint, tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "def f(x, arr):\n"
+        "    a = float(x)\n"                      # bare float()
+        "    b = jax.device_get(x)\n"             # attribute-qualified
+        "    c = device_get(x)\n"                 # bare
+        "    d = arr.item()\n"                    # method .item()
+        "    return a, b, c, d\n"
+    )
+    violations = lint.scan_file(bad)
+    assert [ln for ln, _ in violations] == [3, 4, 5, 6]
+    assert all("host-sync-ok" in msg for _, msg in violations)
+
+
+def test_allowlist_marker_blesses_line_and_next(lint, tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import jax\n"
+        "def f(x):\n"
+        "    a = float(x)  # host-sync-ok: test fixture\n"
+        "    # host-sync-ok: marker-above style\n"
+        "    b = jax.device_get(x)\n"
+        "    return a, b\n"
+    )
+    assert lint.scan_file(ok) == []
+
+
+def test_marker_two_lines_above_does_not_bless(lint, tmp_path):
+    far = tmp_path / "far.py"
+    far.write_text(
+        "def f(x):\n"
+        "    # host-sync-ok: too far away\n"
+        "    y = 1\n"
+        "    return float(x)\n"
+    )
+    assert [ln for ln, _ in lint.scan_file(far)] == [4]
+
+
+def test_comments_and_strings_do_not_count(lint, tmp_path):
+    doc = tmp_path / "doc.py"
+    doc.write_text(
+        '"""This module once called float(x) and arr.item() per step."""\n'
+        "def f():\n"
+        "    # the old code did device_get(scalar) here\n"
+        "    s = 'float(x)'\n"
+        "    return s\n"
+    )
+    assert lint.scan_file(doc) == []
+
+
+def test_non_sync_lookalikes_pass(lint, tmp_path):
+    ok = tmp_path / "lookalike.py"
+    ok.write_text(
+        "def f(t, x):\n"
+        "    a = t.float()\n"          # torch-style method, not builtin float(
+        "    b = item(x)\n"            # bare item() is some other function
+        "    c = x.astype(float)\n"    # float as a name, no call
+        "    return a, b, c\n"
+    )
+    assert lint.scan_file(ok) == []
+
+
+def test_main_reports_path_and_line(lint, tmp_path, capsys):
+    bad = tmp_path / "bad2.py"
+    bad.write_text("def f(x):\n    return x.item()\n")
+    rc = lint.main([str(bad)])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert f"{bad}:2:" in out.out

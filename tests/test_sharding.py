@@ -93,6 +93,64 @@ def test_nonmatching_rules_refused(mesh_tp):
     shard_train_state(state, mesh_tp, DP_RULES)
 
 
+def test_named_strategy_matching_nothing_always_raises(mesh8):
+    """Deterministic companion to test_nonmatching_rules_refused: that test
+    only exercises the refusal branch IF lenet5 happens not to match TP —
+    this one pins the contract unconditionally, for both rule kinds."""
+    from dist_mnist_tpu.parallel.sharding import FSDP_RULES, shard_train_state
+    from dist_mnist_tpu.train.state import TrainState
+
+    # (3, 5) floats: no dim divides the 8-way data axis, and no regex below
+    # matches the path — both named strategies resolve to zero matches.
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params={"conv": {"w": jnp.zeros((3, 5))}},
+        model_state={},
+        opt_state={},
+        rng=jax.random.PRNGKey(0),
+    )
+    named_regex = ShardingRules(rules=((r"qkv/w$", (None, "model")),))
+    assert named_regex.match_count(state.params, mesh8) == 0
+    with pytest.raises(ValueError, match="matched no parameter"):
+        shard_train_state(state, mesh8, named_regex)
+
+    assert FSDP_RULES.match_count(state.params, mesh8) == 0
+    with pytest.raises(ValueError, match="matched no parameter"):
+        shard_train_state(state, mesh8, FSDP_RULES)
+
+
+def test_fsdp_rule_picks_largest_divisible_free_dim(mesh8):
+    from dist_mnist_tpu.parallel.sharding import FSDP_RULES
+
+    # (16, 128): both divide 8; the LARGER dim (128) takes the data axis
+    assert FSDP_RULES.leaf_spec("w", jnp.zeros((16, 128)), mesh8) == P(None, "data")
+    assert FSDP_RULES.leaf_spec("w2", jnp.zeros((128, 16)), mesh8) == P("data", None)
+    assert FSDP_RULES.leaf_spec("b", jnp.zeros((8,)), mesh8) == P("data")
+    # integer leaves and non-divisible shapes stay replicated
+    assert FSDP_RULES.leaf_spec("c", jnp.zeros((8,), jnp.int32), mesh8) == P()
+    assert FSDP_RULES.leaf_spec("d", jnp.zeros((3, 5)), mesh8) == P()
+    assert FSDP_RULES.leaf_spec("s", jnp.zeros(()), mesh8) == P()
+
+
+def test_fsdp_composes_with_tp(mesh_tp):
+    """fsdp_tp: TP's regex owns the `model` placement; FSDP adds `data`
+    (size 4 here) on the largest remaining free divisible dim."""
+    from dist_mnist_tpu.parallel.sharding import FSDP_TP_RULES
+
+    # column-parallel qkv/w (8, 24): TP -> P(None, "model"); dim0=8 %4==0
+    assert (FSDP_TP_RULES.leaf_spec("blk/attn/qkv/w", jnp.zeros((8, 24)), mesh_tp)
+            == P("data", "model"))
+    # row-parallel out/w (24, 8): TP -> P("model", None); dim1=8 %4==0
+    assert (FSDP_TP_RULES.leaf_spec("blk/attn/out/w", jnp.zeros((24, 8)), mesh_tp)
+            == P("model", "data"))
+    # TP-untouched param falls through to the pure FSDP shape rule
+    assert (FSDP_TP_RULES.leaf_spec("embed/w", jnp.zeros((12, 16)), mesh_tp)
+            == P(None, "data"))
+    # TP match whose free dim is not divisible: keep the TP spec as-is
+    assert (FSDP_TP_RULES.leaf_spec("blk/attn/qkv/w", jnp.zeros((7, 24)), mesh_tp)
+            == P(None, "model"))
+
+
 def test_custom_rule_ordering():
     rules = ShardingRules(rules=(
         (r"special/w$", ("data",)),
