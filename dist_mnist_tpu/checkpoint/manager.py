@@ -146,6 +146,30 @@ def _path_names(tree) -> set[str]:
     }
 
 
+def _is_read_corruption(err: Exception) -> bool:
+    """Does `err` look like an UNREADABLE payload (truncated / missing /
+    mangled array data) rather than a structure mismatch or a logic error?
+    This gates the restore FALLBACK ladder (next-older step), which only
+    makes sense for damage local to one step directory — a structural
+    mismatch would fail identically on every older step and must propagate.
+
+    OSError/EOFError are corruption by TYPE (the storage layer itself
+    failed). KeyError/TypeError are structural by construction (the
+    `_is_healable` territory) and never corruption. Tensorstore, however,
+    surfaces short reads as a plain ValueError — for that one type the
+    storage-layer markers in the message are the only evidence there is."""
+    if isinstance(err, (EOFError, OSError)):  # FileNotFoundError is OSError
+        return True
+    if not isinstance(err, ValueError):
+        return False
+    msg = str(err).lower()
+    return any(m in msg for m in (
+        "out_of_range", "data_loss", "error reading", "failed to read",
+        "tensorstore", "ocdbt", "zarr", "truncat", "corrupt", "checksum",
+        "no such file", "could not open",
+    ))
+
+
 def _phrasing_matches(err: Exception) -> bool:
     """The fast path: Orbax's measured structure-mismatch wordings. Kept
     only as a zero-I/O shortcut — classification no longer DEPENDS on
@@ -172,9 +196,14 @@ class CheckpointManager:
         *,
         max_to_keep: int = 5,
         async_save: bool = True,
+        max_restore_fallbacks: int = 1,
     ):
         if not _HAVE_ORBAX:
             raise RuntimeError("orbax-checkpoint is required for CheckpointManager")
+        # how many OLDER steps restore() may fall back to when the latest
+        # is unreadable (each unreadable step is quarantined); 0 disables
+        # the ladder and restores the strict propagate-first-error behavior
+        self.max_restore_fallbacks = max_restore_fallbacks
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
@@ -233,10 +262,37 @@ class CheckpointManager:
         ``convert_block_layout``) is healed transparently: the checkpoint is
         restored in ITS layout and converted to the target's (params AND the
         structurally-mirrored optimizer slots), so flipping `scan_blocks`
-        between runs does not orphan checkpoints (VERDICT r3 weak 7)."""
+        between runs does not orphan checkpoints (VERDICT r3 weak 7).
+
+        A latest step that is UNREADABLE for a non-structural reason
+        (truncated/missing array files — `_is_read_corruption`) falls back
+        to the next-older step, quarantining the bad directory under
+        ``<dir>/quarantine/`` so no later restore trips on it again; at
+        most `max_restore_fallbacks` times. Anything else — and corruption
+        with no older step left — re-raises the ORIGINAL error."""
         step = self.latest_step()
-        if step is None:
-            return None
+        fallbacks = 0
+        while step is not None:
+            try:
+                return self._restore_step(step, target_state)
+            except Exception as err:  # noqa: BLE001 — classified below
+                older = self._step_before(step)
+                if (older is None
+                        or fallbacks >= self.max_restore_fallbacks
+                        or not _is_read_corruption(err)):
+                    raise
+                log.error(
+                    "checkpoint step %d unreadable (%s: %s); quarantining "
+                    "it and falling back to step %d",
+                    step, type(err).__name__, str(err)[:200], older,
+                )
+                self._quarantine(step)
+                fallbacks += 1
+                step = older
+        return None
+
+    def _restore_step(self, step: int, target_state):
+        """Restore ONE specific step (structure healing included)."""
         try:
             restored = self._restore_into(step, target_state)
         except Exception as err:
@@ -251,6 +307,30 @@ class CheckpointManager:
             )
         log.info("restored checkpoint step %d from %s", step, self.directory)
         return restored
+
+    def _step_before(self, step: int) -> int | None:
+        older = [s for s in self._mgr.all_steps() if s < step]
+        return max(older) if older else None
+
+    def _quarantine(self, step: int) -> None:
+        """Move the step's directory out of Orbax's step namespace — to
+        ``<dir>/quarantine/step_<N>`` — so retention, latest_step and any
+        later restore never see it again, then reset the manager's cached
+        step view. Moved, not deleted: the payload stays available for
+        post-mortem."""
+        import shutil
+
+        src = self.directory / str(step)
+        dst_root = self.directory / "quarantine"
+        dst_root.mkdir(exist_ok=True)
+        dst = dst_root / f"step_{step}"
+        if dst.exists():
+            shutil.rmtree(dst)
+        if src.exists():
+            shutil.move(str(src), str(dst))
+        if self._last_saved == step:
+            self._last_saved = None  # a re-save of this step must not dedupe
+        self._mgr.reload()
 
     def _restore_with_structure_healing(self, step, target_state, err):
         """Fallback ladder for known benign structure drifts, tried in

@@ -28,6 +28,7 @@ Usage:
 from __future__ import annotations
 
 import os
+import random
 import signal
 import socket
 import subprocess
@@ -49,6 +50,18 @@ FLAGS = flags.FLAGS
 flags.DEFINE_integer("port", 0, "coordinator port (0 = pick a free one)")
 flags.DEFINE_integer("devices_per_process", 1,
                      "virtual devices per process (cpu platform only)")
+flags.DEFINE_integer("max_restarts", 0,
+                     "supervisor mode: on an abnormal NON-CHIEF process "
+                     "death, restart the cluster (children resume from the "
+                     "checkpoint) with exponential backoff + jitter, up to "
+                     "this many times; 0 = fail fast (legacy behavior)")
+flags.DEFINE_float("restart_backoff_s", 1.0,
+                   "supervisor restart backoff base: attempt k sleeps "
+                   "base * 2^k * (1 + jitter)")
+
+#: children of the CURRENT cluster generation — the conftest leak check
+#: asserts this is empty of live processes after every test.
+_LIVE_CHILDREN: list = []
 
 
 _PORT_LOCK_DIR = Path(tempfile.gettempdir()) / "dist_mnist_tpu_ports"
@@ -102,7 +115,32 @@ def _pump(proc: subprocess.Popen, tag: str) -> None:
         sys.stdout.flush()
 
 
-def launch(
+def _normalize_rc(code: int) -> int:
+    """Deterministic positive exit status: a signal death (negative Popen
+    returncode) maps to the shell convention 128+N, so launch()'s return
+    value — and the supervisor's restart decision — never depends on how
+    the platform spells "killed"."""
+    return 128 - code if code < 0 else code
+
+
+def _describe_exit(tag: str, code: int) -> str:
+    """Human-readable failure cause, exit code and tag included — the
+    string raised/logged so the death isn't lost in the pump output."""
+    if code < 0:
+        try:
+            name = signal.Signals(-code).name
+        except ValueError:
+            name = f"signal {-code}"
+        return f"{tag} exited rc={_normalize_rc(code)} (killed by {name})"
+    return f"{tag} exited rc={code}"
+
+
+def _say(msg: str) -> None:
+    sys.stdout.write(msg + "\n")
+    sys.stdout.flush()
+
+
+def _launch_once(
     num_processes: int,
     train_args: list[str],
     *,
@@ -110,9 +148,22 @@ def launch(
     platform: str | None = None,
     devices_per_process: int = 1,
     env_extra: dict[str, str] | None = None,
-) -> int:
-    """Spawn the cluster; return the first nonzero child exit code (0 = all
-    succeeded). Importable — tests and scripts call this directly."""
+    kill_spec: tuple[int, float] | None = None,
+    child_command: list[str] | None = None,
+) -> tuple[int, str | None, int | None]:
+    """Spawn ONE cluster generation and wait it out.
+
+    Returns ``(rc, failure, first_dead)``: rc is 0 or the normalized exit
+    status of the first abnormal death; `failure` describes that death
+    (None on success and on operator interrupt — the supervisor must not
+    "restart" a Ctrl-C); `first_dead` is the failing process index (the
+    chief-death-is-fatal input).
+
+    `kill_spec` = (process index, delay seconds) injects a launcher-level
+    chaos kill: SIGKILL that child `delay` seconds after spawn
+    (faults/plan.py kill_process). `child_command` replaces the
+    ``python -m dist_mnist_tpu.cli.train`` prefix — the supervisor tests'
+    seam for jax-free stub children."""
     probe, lock = None, None
     if not port:
         port, probe, lock = _reserve_port()
@@ -125,14 +176,17 @@ def launch(
         )
     if env_extra:
         env.update(env_extra)
+    prefix = child_command or [sys.executable, "-m", "dist_mnist_tpu.cli.train"]
 
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
-    rc = 0
+    killer: threading.Thread | None = None
+    killer_stop = threading.Event()
+    rc, failure, first_dead = 0, None, None
     try:
         for i in range(num_processes):
             cmd = [
-                sys.executable, "-m", "dist_mnist_tpu.cli.train",
+                *prefix,
                 f"--coordinator_address={coord}",
                 f"--num_processes={num_processes}",
                 f"--process_id={i}",
@@ -143,9 +197,26 @@ def launch(
                 cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
             )
             procs.append(p)
+            _LIVE_CHILDREN.append(p)
             t = threading.Thread(target=_pump, args=(p, f"p{i}"), daemon=True)
             t.start()
             pumps.append(t)
+        if kill_spec is not None:
+            k, delay = kill_spec
+
+            def _chaos_kill():
+                if killer_stop.wait(delay):
+                    return  # cluster ended first
+                victim = procs[k]
+                if victim.poll() is None:
+                    _say(f"[launcher] fault injected: SIGKILL p{k} "
+                         f"after {delay:.1f}s")
+                    victim.kill()
+
+            killer = threading.Thread(
+                target=_chaos_kill, name=f"FaultKillTimer-p{k}", daemon=True
+            )
+            killer.start()
         # all children exist; release the port for the child coordinator
         # (children spend seconds in jax import before binding it)
         if probe is not None:
@@ -156,15 +227,29 @@ def launch(
         # service's heartbeat timeout — fail fast instead)
         alive = set(range(num_processes))
         while alive:
+            dead: list[tuple[int, int]] = []
             for i in sorted(alive):
                 code = procs[i].poll()
                 if code is None:
                     continue
                 alive.discard(i)
-                if code != 0 and rc == 0:
-                    rc = code
-                    for j in sorted(alive):
-                        procs[j].terminate()
+                if code != 0:
+                    dead.append((i, code))
+            if dead and rc == 0:
+                # attribution within one poll window: a dying WORKER takes
+                # the chief down with it (coordination-service abort), so
+                # when both land in the same tick the worker is the root
+                # cause — blaming the chief would make a survivable worker
+                # crash fatal to the supervisor. The chief is blamed only
+                # when no worker died alongside it.
+                i, code = next(((j, c) for j, c in dead if j != 0), dead[0])
+                rc = _normalize_rc(code)
+                failure = _describe_exit(f"p{i}", code)
+                first_dead = i
+                _say(f"[launcher] {failure}; terminating "
+                     f"{len(alive)} peer(s)")
+                for j in sorted(alive):
+                    procs[j].terminate()
             if alive:
                 try:
                     procs[min(alive)].wait(timeout=0.5)
@@ -183,21 +268,80 @@ def launch(
                 p.wait(timeout=deadline)
             except subprocess.TimeoutExpired:
                 deadline = 0.1
-        rc = 130
+        rc, failure, first_dead = 130, None, None
     finally:
         if probe is not None:
             probe.close()
+        killer_stop.set()
+        if killer is not None:
+            killer.join(timeout=5)
         for p in procs:
             if p.poll() is None:
                 p.kill()
         for t in pumps:
             t.join(timeout=5)
+        for p in procs:
+            p.wait()
+            if p in _LIVE_CHILDREN:
+                _LIVE_CHILDREN.remove(p)
         if lock is not None:
             try:
                 lock.unlink()
             except OSError:
                 pass
-    return rc
+    return rc, failure, first_dead
+
+
+def launch(
+    num_processes: int,
+    train_args: list[str],
+    *,
+    port: int = 0,
+    platform: str | None = None,
+    devices_per_process: int = 1,
+    env_extra: dict[str, str] | None = None,
+    max_restarts: int = 0,
+    restart_backoff_s: float = 1.0,
+    kill_spec: tuple[int, float] | None = None,
+    child_command: list[str] | None = None,
+) -> int:
+    """Spawn the cluster; return 0 or a deterministic nonzero exit status
+    (the first abnormal death's, signal deaths normalized to 128+N).
+    Importable — tests and scripts call this directly.
+
+    With ``max_restarts > 0`` this is a SUPERVISOR: an abnormal non-chief
+    death tears the generation down (a dead peer would park the others in
+    collectives) and relaunches the WHOLE cluster — single-process rejoin
+    is not a thing under jax.distributed, but checkpoint resume makes a
+    generation restart cheap, and the coordinator port is re-reserved
+    fresh each time. Backoff is exponential with jitter. A chief (p0)
+    death is fatal: the chief owns the coordination service, so its loss
+    says the job itself — not one replica — is broken. An operator
+    interrupt (Ctrl-C) is never "restarted"."""
+    rng = random.Random(0)  # deterministic jitter (tests time the backoff)
+    attempt = 0
+    while True:
+        rc, failure, first_dead = _launch_once(
+            num_processes, train_args, port=port, platform=platform,
+            devices_per_process=devices_per_process, env_extra=env_extra,
+            kill_spec=kill_spec if attempt == 0 else None,
+            child_command=child_command,
+        )
+        if rc == 0 or failure is None or max_restarts <= 0:
+            return rc
+        if first_dead == 0:
+            _say(f"[supervisor] chief died ({failure}); fatal — "
+                 f"not restarting, rc={rc}")
+            return rc
+        if attempt >= max_restarts:
+            _say(f"[supervisor] {failure}; giving up after {attempt} "
+                 f"restart(s), rc={rc}")
+            return rc
+        delay = restart_backoff_s * (2 ** attempt) * (1.0 + 0.5 * rng.random())
+        attempt += 1
+        _say(f"[supervisor] {failure}; restarting cluster "
+             f"(attempt {attempt}/{max_restarts}) in {delay:.2f}s")
+        time.sleep(delay)
 
 
 #: launcher-owned / per-child flags that must NOT be blanket-forwarded
@@ -230,12 +374,23 @@ def main(argv):
     # passthrough after `--` (duplicates are fine: the later, explicit
     # occurrence wins in the child's absl parse)
     train_args = _forwarded_train_flags() + [a for a in argv[1:] if a != "--"]
+    # one plan, two layers: the launcher takes the kill_process fault;
+    # --fault_plan is a cli.train flag, so the SAME plan is forwarded to
+    # the children, which consume the in-process kinds
+    kill_spec = None
+    if FLAGS.fault_plan:
+        from dist_mnist_tpu.faults import FaultPlan
+
+        kill_spec = FaultPlan.from_spec(FLAGS.fault_plan).kill_spec()
     rc = launch(
         FLAGS.num_processes,
         train_args,
         port=FLAGS.port,
         platform=FLAGS.platform,
         devices_per_process=FLAGS.devices_per_process,
+        max_restarts=FLAGS.max_restarts,
+        restart_backoff_s=FLAGS.restart_backoff_s,
+        kill_spec=kill_spec,
     )
     if rc:
         sys.exit(rc)

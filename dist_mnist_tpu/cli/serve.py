@@ -42,6 +42,11 @@ flags.DEFINE_boolean("prewarm", True, "compile all buckets before serving")
 flags.DEFINE_integer("requests", 512, "loadgen request count")
 flags.DEFINE_integer("concurrency", 64, "loadgen in-flight window")
 flags.DEFINE_integer("seed", 0, "loadgen input seed")
+flags.DEFINE_string("fault_plan", None,
+                    "inline JSON or file path of a faults/plan.py FaultPlan; "
+                    "serve_error faults wrap the engine so the batcher's "
+                    "fail-one-batch-keep-serving isolation is drivable from "
+                    "the CLI (docs/RESILIENCE.md)")
 
 
 def main(argv):
@@ -83,6 +88,10 @@ def main(argv):
         model_name=cfg.model, image_shape=bundle.image_shape,
         rules=bundle.rules, max_bucket=max(FLAGS.max_batch, 1),
     )
+    if FLAGS.fault_plan:
+        from dist_mnist_tpu.faults import FaultPlan
+
+        engine = FaultPlan.from_spec(FLAGS.fault_plan).wrap_engine(engine)
     writer = make_default_writer(FLAGS.logdir)
     server = InferenceServer(
         engine,

@@ -96,6 +96,18 @@ flags.DEFINE_integer("runahead", 0,
                      "per-step sync); 0 = unbounded")
 flags.DEFINE_integer("max_recoveries", 3,
                      "preemption restore attempts (needs checkpoint_dir)")
+flags.DEFINE_integer("max_restore_fallbacks", 1,
+                     "when the LATEST checkpoint is unreadable (truncated/"
+                     "missing array files), fall back to up to this many "
+                     "older steps, quarantining each bad step directory "
+                     "(checkpoint/manager.py); 0 = strict, propagate the "
+                     "read error")
+flags.DEFINE_string("fault_plan", None,
+                    "fault-injection plan: inline JSON or a path to a JSON "
+                    "file (dist_mnist_tpu/faults/plan.py). Faults fire "
+                    "deterministically at their configured steps; the same "
+                    "plan drives launcher-level kills (cli/launch.py) and "
+                    "in-process faults here")
 flags.DEFINE_integer("scan_chunk", 0,
                      "compile N steps into one lax.scan program (needs a "
                      "device input pipeline); hooks fire per chunk. The "
@@ -165,6 +177,9 @@ def _run_config(
     scan_chunk: int = 0,
     prefetch_depth: int = 0,
     runahead: int = 0,
+    fault_plan=None,
+    preemption=None,
+    max_restore_fallbacks: int = 1,
 ):
     """Implementation behind `run_config` (the public wrapper adds the
     PRNG-impl scope — call THAT, not this).
@@ -234,7 +249,13 @@ def _run_config(
         manager = None
         restored = False
         if checkpoint_dir:
-            manager = CheckpointManager(checkpoint_dir)
+            manager = CheckpointManager(
+                checkpoint_dir, max_restore_fallbacks=max_restore_fallbacks
+            )
+            if fault_plan is not None:
+                # wrap BEFORE the startup restore so a corrupt fault
+                # targeting a pre-existing step fires on restore_or_init too
+                manager = fault_plan.wrap_checkpoint_manager(manager)
             state, restored = manager.restore_or_init(state)
         log.info(
             "config %s: model=%s params on %d devices, restored=%s",
@@ -291,6 +312,12 @@ def _run_config(
             hooks_lib.MemoryHook(writer, every_steps=cfg.log_every),
             hooks_lib.NaNGuardHook(),
         ]
+        from dist_mnist_tpu.faults.goodput import GoodputHook
+
+        goodput_hook = GoodputHook(writer, every_steps=cfg.log_every)
+        hooks.append(goodput_hook)
+        if fault_plan is not None:
+            hooks.append(fault_plan.hook())
         eval_hook = None
         if cfg.eval_every:
             eval_hook = hooks_lib.EvalHook(eval_fn, every_steps=cfg.eval_every,
@@ -331,6 +358,10 @@ def _run_config(
             from dist_mnist_tpu.data.prefetch import DevicePrefetcher
 
             batches = DevicePrefetcher(batches, depth=prefetch_depth)
+        if fault_plan is not None:
+            # outermost wrapper: an injected stall lands in the loop's feed
+            # wait (goodput stall bucket), like any real input outage
+            batches = fault_plan.wrap_batches(batches)
         loop = TrainLoop(
             step_fn,
             state,
@@ -340,6 +371,7 @@ def _run_config(
             max_recoveries=max_recoveries,
             steps_per_call=max(1, scan_chunk),
             runahead=runahead,
+            preemption=preemption,
         )
         state = loop.run()
         # EvalHook.end already evaluated the final state; don't pay for a
@@ -354,7 +386,9 @@ def _run_config(
     if manager:
         manager.close()
     return state, final, {"mesh": mesh, "model": model, "elapsed": elapsed,
-                          "dataset": dataset}
+                          "dataset": dataset, "loop": loop,
+                          "goodput": goodput_hook.last,
+                          "preempted_at": loop.preempted_at}
 
 
 def _apply_flag_overrides(cfg):
@@ -426,7 +460,16 @@ def main(argv):
     from dist_mnist_tpu.cluster import initialize_distributed
     from dist_mnist_tpu.configs import get_config
     from dist_mnist_tpu.data import load_dataset
+    from dist_mnist_tpu.faults import (
+        FaultPlan,
+        PreemptionNotice,
+        install_preemption_handlers,
+    )
 
+    # handshake installed BEFORE the expensive jax/distributed bring-up: a
+    # SIGTERM that lands during init is honored at the first step boundary
+    notice = PreemptionNotice()
+    uninstall = install_preemption_handlers(notice)
     initialize_distributed(
         FLAGS.coordinator_address, FLAGS.num_processes, FLAGS.process_id,
         platform=FLAGS.platform, host_device_count=FLAGS.host_device_count,
@@ -437,18 +480,30 @@ def main(argv):
         log.info("dataset %s ready (%d train / %d test, synthetic=%s)",
                  ds.name, len(ds.train_labels), len(ds.test_labels), ds.synthetic)
         return
-    run_config(
-        cfg,
-        data_dir=FLAGS.data_dir,
-        checkpoint_dir=FLAGS.checkpoint_dir,
-        logdir=FLAGS.logdir,
-        profile=FLAGS.profile,
-        max_recoveries=FLAGS.max_recoveries if FLAGS.checkpoint_dir else 0,
-        input_pipeline=FLAGS.input_pipeline,
-        scan_chunk=FLAGS.scan_chunk,
-        prefetch_depth=FLAGS.prefetch_depth,
-        runahead=FLAGS.runahead,
-    )
+    plan = FaultPlan.from_spec(FLAGS.fault_plan) if FLAGS.fault_plan else None
+    try:
+        _state, _final, ctx = run_config(
+            cfg,
+            data_dir=FLAGS.data_dir,
+            checkpoint_dir=FLAGS.checkpoint_dir,
+            logdir=FLAGS.logdir,
+            profile=FLAGS.profile,
+            max_recoveries=FLAGS.max_recoveries if FLAGS.checkpoint_dir else 0,
+            input_pipeline=FLAGS.input_pipeline,
+            scan_chunk=FLAGS.scan_chunk,
+            prefetch_depth=FLAGS.prefetch_depth,
+            runahead=FLAGS.runahead,
+            fault_plan=plan,
+            preemption=notice,
+            max_restore_fallbacks=FLAGS.max_restore_fallbacks,
+        )
+    finally:
+        uninstall()
+    if ctx.get("preempted_at") is not None:
+        # the marker line supervisors/tests key on; exit code stays 0 — a
+        # preempted-but-checkpointed run is a SUCCESS to any scheduler
+        log.warning("preempted@step=%d — checkpoint saved, clean shutdown",
+                    ctx["preempted_at"])
 
 
 if __name__ == "__main__":

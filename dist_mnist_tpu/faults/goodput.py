@@ -1,0 +1,188 @@
+"""Goodput accounting: wall-time attribution for the train loop.
+
+The reference had no notion of goodput — a preempted worker simply
+re-ran `prepare_session` and the lost minutes were invisible (SURVEY.md
+§3.2). Here every second of the loop's wall clock is attributed to one
+of four buckets, so resilience work (faults/, checkpoint fallback,
+supervised restarts) has a metric to move:
+
+- ``productive_s`` — steps that advanced the FRONTIER of training.
+- ``replay_s``     — steps re-executed after a restore to get back to
+                     the pre-failure step (the recovered trajectory must
+                     equal the uninterrupted one — train/loop.py re-seeks
+                     the input stream — so these are real, correct steps,
+                     but they produced no NEW progress).
+- ``restore_s``    — checkpoint restore + input re-seek on recovery.
+- ``stall_s``      — blocked pulling the next batch or on the runahead
+                     bound (the InputPipelineHook's feed/runahead clocks,
+                     summed).
+
+``goodput_fraction = productive_s / total_wall_s`` — everything not in
+the productive bucket (including untracked overhead: hook bodies, eval,
+checkpoint saves) is lost goodput. Per-recovery events additionally
+record ``latency_s = restore_s + replay_s`` — the wall time from the
+failure to the first post-failure step that advanced the frontier —
+which `bench.py --faults` reports as ``recovery_latency_ms``.
+
+Stdlib-only on purpose: train/loop.py imports this module at its top,
+so it must not pull jax or the rest of the faults package.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class GoodputClock:
+    """Bucketed wall-clock attribution + per-recovery latency events.
+
+    Owned and fed by `TrainLoop` (one instance per loop); read by
+    `GoodputHook` and by bench harnesses via `snapshot()`.
+    """
+
+    def __init__(self):
+        self.productive_s = 0.0
+        self.replay_s = 0.0
+        self.restore_s = 0.0
+        self.stall_s = 0.0
+        self.replayed_steps = 0
+        #: one dict per recovery: failed_at_step, restored_step, restore_s,
+        #: replay_s, replayed_steps, complete, latency_s (once known)
+        self.events: list[dict] = []
+        self._t0: float | None = None
+        self._t_end: float | None = None
+        self._open: dict | None = None  # recovery currently being replayed
+
+    # -- loop feed points ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+
+    def add_stall(self, dt: float) -> None:
+        self.stall_s += dt
+
+    def add_productive(self, dt: float) -> None:
+        self.productive_s += dt
+
+    @property
+    def in_replay(self) -> bool:
+        return self._open is not None
+
+    def begin_recovery(self, *, failed_at_step: int, restored_step: int,
+                       restore_s: float) -> None:
+        """A restore just completed: open a recovery event. Replay time is
+        charged to it until the loop re-reaches `failed_at_step`."""
+        self.restore_s += restore_s
+        ev = {
+            "failed_at_step": failed_at_step,
+            "restored_step": restored_step,
+            "restore_s": restore_s,
+            "replay_s": 0.0,
+            "replayed_steps": 0,
+            "complete": False,
+        }
+        self.events.append(ev)
+        self._open = ev
+        if restored_step >= failed_at_step:
+            # checkpoint landed exactly at the failure step: nothing to replay
+            self._finish_open()
+
+    def note_replay(self, dt: float, steps: int, *, at_step: int) -> None:
+        """A step executed while catching back up to the failure point."""
+        self.replay_s += dt
+        self.replayed_steps += steps
+        if self._open is not None:
+            self._open["replay_s"] += dt
+            self._open["replayed_steps"] += steps
+            if at_step >= self._open["failed_at_step"]:
+                self._finish_open()
+
+    def _finish_open(self) -> None:
+        ev, self._open = self._open, None
+        if ev is not None:
+            ev["complete"] = True
+            ev["latency_s"] = ev["restore_s"] + ev["replay_s"]
+
+    def close(self) -> None:
+        """Freeze the clock (loop's finally). A recovery still open here
+        means the loop ended mid-replay: its latency is recorded as the
+        partial restore+replay, with ``complete`` left False."""
+        if self._open is not None:
+            ev, self._open = self._open, None
+            ev["latency_s"] = ev["restore_s"] + ev["replay_s"]
+        if self._t_end is None and self._t0 is not None:
+            self._t_end = time.monotonic()
+
+    # -- read side ----------------------------------------------------------
+
+    def total_wall_s(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        end = self._t_end if self._t_end is not None else time.monotonic()
+        return end - self._t0
+
+    def goodput_fraction(self) -> float:
+        total = self.total_wall_s()
+        return self.productive_s / total if total > 0 else 0.0
+
+    def recovery_latency_s(self) -> float:
+        """Mean failure->frontier latency over recorded recoveries; 0.0
+        when the run had none."""
+        lats = [ev["latency_s"] for ev in self.events if "latency_s" in ev]
+        return sum(lats) / len(lats) if lats else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "productive_s": self.productive_s,
+            "replay_s": self.replay_s,
+            "restore_s": self.restore_s,
+            "stall_s": self.stall_s,
+            "total_wall_s": self.total_wall_s(),
+            "goodput_fraction": self.goodput_fraction(),
+            "recoveries": len(self.events),
+            "replayed_steps": self.replayed_steps,
+            "recovery_latency_ms": self.recovery_latency_s() * 1000.0,
+        }
+
+
+class GoodputHook:
+    """Publish the loop's GoodputClock as ``goodput/*`` scalars.
+
+    Same shape as the other observability hooks (hooks/builtin.py): reads
+    host-side counters only — never a device value — writes one batched
+    scalars() call per cadence, and keeps the latest snapshot in ``last``
+    for bench harnesses."""
+
+    def __init__(self, writer=None, *, every_steps: int | None = 100):
+        from dist_mnist_tpu.hooks.base import EverySteps
+
+        self._writer = writer
+        self._timer = EverySteps(every_steps=every_steps or 100)
+        self._loop = None
+        self.last: dict = {}
+
+    def begin(self, loop) -> None:
+        self._loop = loop
+        self._timer.prime(loop.initial_step)
+
+    def before_step(self, step: int) -> None:
+        pass
+
+    def after_step(self, step: int, state, outputs) -> None:
+        if self._timer.should_trigger(step):
+            self._timer.mark()
+            self._publish(step)
+
+    def end(self, state) -> None:
+        self._publish(None)
+
+    def _publish(self, step: int | None) -> None:
+        if self._loop is None:
+            return
+        snap = self._loop.goodput.snapshot()
+        self.last = snap
+        if self._writer is not None and step is not None:
+            self._writer.scalars(
+                {f"goodput/{k}": v for k, v in snap.items()}, step
+            )

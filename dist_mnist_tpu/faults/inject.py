@@ -1,0 +1,221 @@
+"""Injector shims: where a `FaultPlan`'s faults actually land.
+
+Each shim wraps one seam of the real system and injects ITS fault kinds,
+delegating everything else untouched — the wrapped object's contract
+(at_step/close on iterators, save/restore on the checkpoint manager,
+predict on the serve engine) is preserved so the shims compose with the
+production wiring (prefetcher above or below the stall shim, CheckpointHook
+holding the wrapped manager, DynamicBatcher holding the wrapped engine).
+
+Injection points, chosen so each fault exercises the REAL recovery path:
+
+- preempt: raised from `FaultInjectionHook.before_step`, which the loop
+  calls inside its recovery try-block with the loop's own host step —
+  the one clock that stays correct across restores (a wrapped step_fn's
+  call counter runs ahead of the global step during replay; see
+  `FaultyStepFn`'s caveat).
+- corrupt_checkpoint: applied to the on-disk step directory at RESTORE
+  time, after `wait()` — deterministic under async save, and it hits the
+  exact read path `CheckpointManager`'s fallback ladder defends.
+- stall_input: a sleep in the batch feed, visible to the loop as feed
+  wait (goodput stall bucket) like any real input outage.
+- serve_error: raised from `predict()` under the DynamicBatcher, which
+  must fail ONLY that batch's futures and keep serving.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from pathlib import Path
+
+from dist_mnist_tpu.faults.plan import FaultPlan
+from dist_mnist_tpu.train.loop import PreemptionError
+
+log = logging.getLogger(__name__)
+
+
+class FaultInjectionHook:
+    """Raises planned preemptions at the loop's step clock.
+
+    `before_step(step)` runs inside TrainLoop's try-block, so the raise
+    takes the production recovery path: classify via `_is_preemption`,
+    restore the latest checkpoint, re-seek the input stream, replay."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def begin(self, loop) -> None:
+        pass
+
+    def before_step(self, step: int) -> None:
+        # >= not ==: a chunked loop (steps_per_call > 1) can cross the
+        # trigger without landing on it; `fired` keeps it at-most-once,
+        # so replayed steps below the trigger never re-raise
+        for f in self.plan.pending("preempt"):
+            if f.step is not None and step >= f.step:
+                f.fired = True
+                log.warning("fault injected: preemption at step %d", step)
+                raise PreemptionError(f"injected preemption at step {step}")
+
+    def after_step(self, step: int, state, outputs) -> None:
+        pass
+
+    def end(self, state) -> None:
+        pass
+
+
+class FaultyBatches:
+    """Batch-stream wrapper injecting input stalls.
+
+    Mirrors the stream contract the loop relies on — `at_step` re-seek
+    (preserving this wrapper and its plan across recoveries) and
+    generator `close()` propagation — so it can sit above ShardedBatcher,
+    NativeBatcher, or DevicePrefetcher."""
+
+    def __init__(self, inner, plan: FaultPlan, *, start_step: int = 0):
+        self._inner = inner
+        self._plan = plan
+        self._start = start_step
+
+    def at_step(self, step: int) -> "FaultyBatches":
+        inner = (self._inner.at_step(step)
+                 if hasattr(self._inner, "at_step") else self._inner)
+        return FaultyBatches(inner, self._plan, start_step=step)
+
+    def __iter__(self):
+        it = iter(self._inner)
+        step = self._start
+        try:
+            while True:
+                for f in self._plan.pending("stall_input"):
+                    if f.step is not None and step >= f.step:
+                        f.fired = True
+                        log.warning(
+                            "fault injected: input stall %.2fs at step %d",
+                            f.seconds or 0.0, step,
+                        )
+                        time.sleep(f.seconds or 0.0)
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                yield batch
+                step += 1
+        finally:
+            if hasattr(it, "close"):
+                it.close()  # drain a prefetch worker promptly
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _corrupt_step_dir(step_dir: Path, mode: str = "truncate") -> Path | None:
+    """Damage the step's LARGEST file (the array payload, not metadata) —
+    the realistic partial-write/short-read failure a preempted writer or
+    a bad disk produces. Returns the damaged path (None if nothing to
+    damage)."""
+    files = sorted(
+        (p for p in step_dir.rglob("*") if p.is_file()),
+        key=lambda p: (p.stat().st_size, str(p)),
+        reverse=True,
+    )
+    if not files:
+        return None
+    target = files[0]
+    if mode == "delete":
+        target.unlink()
+    else:
+        with open(target, "r+b") as fh:
+            fh.truncate(max(1, target.stat().st_size // 2))
+    return target
+
+
+class FaultyCheckpointManager:
+    """Checkpoint-manager wrapper corrupting planned steps on disk.
+
+    Corruption happens at RESTORE time (after `wait()`, so async writes
+    have landed) rather than at save time — deterministic regardless of
+    save timing, and it exercises exactly the unreadable-latest path that
+    `CheckpointManager.restore`'s fallback ladder defends."""
+
+    def __init__(self, inner, plan: FaultPlan):
+        self._inner = inner
+        self._plan = plan
+
+    def restore(self, target_state):
+        for f in self._plan.pending("corrupt_checkpoint"):
+            if f.step is None:
+                continue
+            step_dir = Path(self._inner.directory) / str(f.step)
+            if not step_dir.exists():
+                continue  # not on disk yet; stays pending for a later restore
+            self._inner.wait()
+            damaged = _corrupt_step_dir(step_dir, mode=f.mode)
+            f.fired = True
+            log.warning(
+                "fault injected: %s checkpoint step %d (%s)",
+                f.mode, f.step, damaged,
+            )
+        return self._inner.restore(target_state)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class FaultyEngine:
+    """Serve-engine wrapper raising on a planned predict-call ordinal.
+
+    The DynamicBatcher above it must fail only that batch's futures and
+    keep serving (serve/batcher.py) — this shim makes that isolation
+    testable without a real device error."""
+
+    def __init__(self, inner, plan: FaultPlan):
+        self._inner = inner
+        self._plan = plan
+        self._calls = 0
+
+    def predict(self, *args, **kwargs):
+        call = self._calls
+        self._calls += 1
+        for f in self._plan.pending("serve_error"):
+            if f.request is not None and call >= f.request:
+                f.fired = True
+                log.warning(
+                    "fault injected: serve engine error on predict call %d",
+                    call,
+                )
+                raise RuntimeError(
+                    f"injected serve engine error on predict call {call}"
+                )
+        return self._inner.predict(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class FaultyStepFn:
+    """Standalone step_fn wrapper raising planned preemptions by CALL count.
+
+    Caveat, and why the loop path uses `FaultInjectionHook` instead: this
+    clock counts calls from `initial_step`, so after an in-loop restore the
+    replayed steps advance it PAST the global step — fine for driving a
+    bare step_fn (unit tests, harnesses without hooks), wrong as the
+    trigger clock inside a recovering TrainLoop."""
+
+    def __init__(self, step_fn, plan: FaultPlan, *, initial_step: int = 0):
+        self._fn = step_fn
+        self._plan = plan
+        self._step = initial_step
+
+    def __call__(self, state, batch):
+        step = self._step
+        for f in self._plan.pending("preempt"):
+            if f.step is not None and step >= f.step:
+                f.fired = True
+                raise PreemptionError(
+                    f"injected preemption at step call {step}"
+                )
+        out = self._fn(state, batch)
+        self._step += 1
+        return out

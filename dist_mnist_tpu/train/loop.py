@@ -23,6 +23,7 @@ from typing import Iterable, Sequence
 
 import jax
 
+from dist_mnist_tpu.faults.goodput import GoodputClock
 from dist_mnist_tpu.hooks.base import Hook
 from dist_mnist_tpu.train.state import TrainState
 
@@ -36,13 +37,17 @@ class PreemptionError(RuntimeError):
 
 #: Exceptions treated as recoverable, mirroring _PREEMPTION_ERRORS
 #: (monitored_session.py:43-45). jax surfaces device loss as XlaRuntimeError
-#: (a subclass of JaxRuntimeError); we match by name to stay version-proof.
+#: (a subclass of JaxRuntimeError); we match by name (anywhere in the MRO)
+#: to stay version-proof. Type is checked FIRST, and only then the status
+#: substrings: an application ValueError whose message happens to contain
+#: "preempt" must not buy a silent restore.
 def _is_preemption(exc: BaseException) -> bool:
     if isinstance(exc, PreemptionError):
         return True
-    return type(exc).__name__ in ("XlaRuntimeError", "JaxRuntimeError") and any(
-        s in str(exc) for s in ("UNAVAILABLE", "ABORTED", "preempt")
-    )
+    mro_names = {c.__name__ for c in type(exc).__mro__}
+    if not mro_names & {"XlaRuntimeError", "JaxRuntimeError"}:
+        return False
+    return any(s in str(exc) for s in ("UNAVAILABLE", "ABORTED", "preempt"))
 
 
 class StopSignal:
@@ -89,6 +94,7 @@ class TrainLoop:
         max_recoveries: int = 0,
         steps_per_call: int = 1,
         runahead: int = 0,
+        preemption=None,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -97,6 +103,14 @@ class TrainLoop:
         self.stop = StopSignal()
         self.checkpoint_manager = checkpoint_manager
         self.max_recoveries = max_recoveries
+        # preemption handshake (faults/preemption.py): a PreemptionNotice
+        # checked at each step boundary — checkpoint, then stop cleanly
+        # with `preempted_at` set, so the process can exit 0.
+        self.preemption = preemption
+        self.preempted_at: int | None = None
+        # goodput attribution (faults/goodput.py): every second of run()'s
+        # wall clock lands in a productive/restore/replay/stall bucket.
+        self.goodput = GoodputClock()
         # >1 when step_fn executes a compiled CHUNK of steps (lax.scan —
         # train/step.make_scanned_train_fn): hooks fire once per chunk at
         # the post-chunk step number; cadences/stops round up to the chunk.
@@ -119,20 +133,48 @@ class TrainLoop:
     def request_stop(self, reason: str | None = None) -> None:
         self.stop.request_stop(reason)
 
+    def _honor_preemption(self) -> None:
+        """Consume a preemption notice at a step boundary: persist state
+        durably, record `preempted_at`, and stop cleanly — hooks and the
+        prefetch worker drain through run()'s normal finally path. The
+        reference had no such handshake: SIGTERM mid-step simply killed
+        the worker and the next start replayed from the last checkpoint."""
+        step = self._host_step
+        if self.checkpoint_manager is not None:
+            self.checkpoint_manager.save(self.state)
+            self.checkpoint_manager.wait()  # durable BEFORE the process exits
+        self.preempted_at = step
+        log.warning(
+            "preemption notice (%s) honored at step boundary %d; "
+            "checkpoint %s — stopping cleanly",
+            getattr(self.preemption, "reason", None), step,
+            "saved" if self.checkpoint_manager is not None else "skipped",
+        )
+        self.request_stop(f"preempted@step={step}")
+
     def run(self) -> TrainState:
         for h in self.hooks:
             h.begin(self)
         recoveries = 0
         it = iter(self.batches)
+        g = self.goodput
+        g.start()
         try:
             while not self.stop.should_stop():
+                # preemption handshake: consumed only at step boundaries,
+                # so the saved checkpoint is always a whole-step state
+                if self.preemption is not None and self.preemption.requested():
+                    self._honor_preemption()
+                    break
                 t_feed = time.monotonic()
                 try:
                     batch = next(it)
                 except StopIteration:
                     self.request_stop("data exhausted")
                     break
-                self.feed_wait_s += time.monotonic() - t_feed
+                dt_feed = time.monotonic() - t_feed
+                self.feed_wait_s += dt_feed
+                g.add_stall(dt_feed)
                 try:
                     # runahead bound: before dispatching this call, wait on
                     # the OLDEST in-flight output — one wait per step, never
@@ -140,10 +182,13 @@ class TrainLoop:
                     if self.runahead and len(self._inflight) >= self.runahead:
                         t_wait = time.monotonic()
                         jax.block_until_ready(self._inflight.popleft())
-                        self.runahead_wait_s += time.monotonic() - t_wait
+                        dt_wait = time.monotonic() - t_wait
+                        self.runahead_wait_s += dt_wait
+                        g.add_stall(dt_wait)
                     # step number BEFORE the step executes == the step being
                     # run; hooks see the post-step number like global_step
                     # reads did after the AssignAdd (§3.3).
+                    t_step = time.monotonic()
                     for h in self.hooks:
                         h.before_step(self._host_step)
                     new_state, outputs = self.step_fn(self.state, batch)
@@ -153,6 +198,15 @@ class TrainLoop:
                         self._inflight.append(outputs)
                     for h in self.hooks:
                         h.after_step(self._host_step, self.state, outputs)
+                    dt_step = time.monotonic() - t_step
+                    if g.in_replay:
+                        # catching back up to the pre-failure step: correct
+                        # work, but no NEW progress — charged to replay, and
+                        # to the open recovery event's latency
+                        g.note_replay(dt_step, self.steps_per_call,
+                                      at_step=self._host_step)
+                    else:
+                        g.add_productive(dt_step)
                 except Exception as exc:  # noqa: BLE001 — classified below
                     # in-flight outputs reference pre-failure buffers;
                     # waiting on them after a restore could resurface the
@@ -169,10 +223,12 @@ class TrainLoop:
                         "recoverable failure (%s); restore attempt %d/%d",
                         exc, recoveries, self.max_recoveries,
                     )
+                    t_restore = time.monotonic()
                     restored = self.checkpoint_manager.restore(self.state)
                     if restored is None:
                         raise
                     self.state = restored
+                    failed_at = self._host_step
                     self._host_step = self.state.step_int
                     # re-seek the input stream to the restored step so the
                     # recovered trajectory equals the uninterrupted one
@@ -183,7 +239,13 @@ class TrainLoop:
                             it.close()  # drain a prefetch worker promptly
                         self.batches = self.batches.at_step(self._host_step)
                         it = iter(self.batches)
+                    g.begin_recovery(
+                        failed_at_step=failed_at,
+                        restored_step=self._host_step,
+                        restore_s=time.monotonic() - t_restore,
+                    )
         finally:
+            g.close()
             self._inflight.clear()
             # generators (incl. DevicePrefetcher streams) drain their
             # resources here — on normal exit AND on an escaping exception

@@ -9,7 +9,8 @@ round-trip did (SURVEY.md §3.3) — and it regresses silently, because the
 numbers stay correct. This lint makes the sync surface explicit:
 
 - Scanned modules (the hot paths): ``dist_mnist_tpu/train/``,
-  ``dist_mnist_tpu/data/prefetch.py``, ``dist_mnist_tpu/hooks/builtin.py``.
+  ``dist_mnist_tpu/faults/``, ``dist_mnist_tpu/data/prefetch.py``,
+  ``dist_mnist_tpu/hooks/builtin.py``.
 - Flagged constructs: ``float(`` and ``device_get(`` calls, and ``.item()``
   — each an implicit (or explicit) device->host blocking transfer when its
   operand is a device array.
@@ -52,6 +53,9 @@ METHOD_NAMES = ("item",)
 def default_targets(repo_root: Path) -> list[Path]:
     pkg = repo_root / "dist_mnist_tpu"
     targets = sorted((pkg / "train").glob("*.py"))
+    # faults/ sits inside the loop (injection hook per step, goodput clock
+    # per iteration) — same hot-path rules apply
+    targets += sorted((pkg / "faults").glob("*.py"))
     targets += [pkg / "data" / "prefetch.py", pkg / "hooks" / "builtin.py"]
     return [t for t in targets if t.exists()]
 

@@ -49,6 +49,13 @@ run_stage bench_vit_pp   1800 python bench.py --config vit_tiny_cifar_pp --deadl
 run_stage bench_vit_flash 1800 python bench.py --config vit_tiny_cifar_flash --deadline 1700
 run_stage bench_vit_ring_flash 1800 python bench.py --config vit_tiny_cifar_ring_flash --deadline 1700
 run_stage bench_vit_uly_flash 1800 python bench.py --config vit_tiny_cifar_ulysses_flash --deadline 1700
+# subsystem modes: serving latency, input-stall attribution, HBM
+# attribution, and resilience (recovery latency + goodput) — all
+# self-contained bench modes with the same one-JSON-line contract
+run_stage bench_serve     900 python bench.py --serve --deadline 800
+run_stage bench_input     900 python bench.py --input --steps 200 --deadline 800
+run_stage bench_memory    900 python bench.py --memory --deadline 800
+run_stage bench_faults    900 python bench.py --faults --deadline 800
 run_stage step_ablation  1800 python scripts/step_ablation.py
 run_stage vit_probe      3600 python scripts/vit_probe.py
 run_stage perf_sweep     1800 python scripts/perf_sweep.py

@@ -54,10 +54,14 @@ def rng():
 
 @pytest.fixture(autouse=True)
 def _no_leaked_prefetch_workers():
-    """Every DevicePrefetcher worker must be joined by test end — a leaked
-    worker means some path (exception, early close, re-seek) skipped the
-    stream drain. Polls briefly: a worker that JUST saw its stop flag may
-    still be mid-exit when the test returns."""
+    """Every background resource must be drained by test end: prefetch
+    workers (a leak means some path — exception, early close, re-seek —
+    skipped the stream drain), fault-injection timer threads (``Fault*``,
+    cli/launch.py's chaos kill), and supervisor child PROCESSES (a live
+    child after launch() returned would outlive the test and poison the
+    next one's port/coordinator). Polls briefly: a worker that JUST saw
+    its stop flag may still be mid-exit when the test returns."""
+    import sys
     import threading
     import time
 
@@ -65,13 +69,20 @@ def _no_leaked_prefetch_workers():
 
     yield
     deadline = time.monotonic() + 2.0
+    leaked: list = ["unchecked"]
     while time.monotonic() < deadline:
         leaked = [t.name for t in threading.enumerate()
-                  if t.name.startswith(THREAD_NAME_PREFIX) and t.is_alive()]
+                  if t.is_alive()
+                  and (t.name.startswith(THREAD_NAME_PREFIX)
+                       or t.name.startswith("Fault"))]
+        launch_mod = sys.modules.get("dist_mnist_tpu.cli.launch")
+        if launch_mod is not None:
+            leaked += [f"child pid={p.pid}" for p in launch_mod._LIVE_CHILDREN
+                       if p.poll() is None]
         if not leaked:
             return
         time.sleep(0.02)
-    raise AssertionError(f"leaked DevicePrefetcher worker threads: {leaked}")
+    raise AssertionError(f"leaked background workers/children: {leaked}")
 
 
 @pytest.fixture(scope="session")

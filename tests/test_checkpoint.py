@@ -406,3 +406,73 @@ def test_restore_weights_empty_dir(tmp_path):
     mgr = CheckpointManager(tmp_path, async_save=False)
     assert mgr.restore_weights({}, {}) is None
     mgr.close()
+
+
+# -- corruption fallback ladder (faults PR) ----------------------------------
+
+def _save_steps(mgr, state, steps):
+    """Save `state` at each step number (orbax keys saves on state.step)."""
+    import dataclasses
+
+    for s in steps:
+        mgr.save(dataclasses.replace(state, step=jnp.int32(s)))
+    mgr.wait()
+
+
+def test_corrupt_latest_falls_back_and_quarantines(tmp_path, state):
+    """An unreadable latest checkpoint (truncated payload — the partial
+    write a preempted saver leaves behind) must not brick the restart:
+    restore quarantines it and falls back to the previous step."""
+    from dist_mnist_tpu.faults.inject import _corrupt_step_dir
+
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    _save_steps(mgr, state, [0, 1])
+    assert _corrupt_step_dir(tmp_path / "1") is not None
+    restored = mgr.restore(state)
+    assert restored is not None and restored.step_int == 0
+    assert (tmp_path / "quarantine" / "step_1").exists()
+    assert not (tmp_path / "1").exists()
+    # the manager stays usable: save after quarantine, restore the new latest
+    _save_steps(mgr, state, [2])
+    assert mgr.latest_step(refresh=True) == 2
+    assert mgr.restore(state).step_int == 2
+    mgr.close()
+
+
+def test_corrupt_only_checkpoint_raises_original_error(tmp_path, state):
+    """No older step to fall back to: the ORIGINAL read error propagates
+    (truly-unrecoverable must stay loud, not return None as cold-start)."""
+    from dist_mnist_tpu.faults.inject import _corrupt_step_dir
+
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    _save_steps(mgr, state, [0])
+    _corrupt_step_dir(tmp_path / "0")
+    with pytest.raises(ValueError, match="(?i)out_of_range|error reading"):
+        mgr.restore(state)
+    mgr.close()
+
+
+def test_max_restore_fallbacks_zero_disables_ladder(tmp_path, state):
+    from dist_mnist_tpu.faults.inject import _corrupt_step_dir
+
+    mgr = CheckpointManager(tmp_path, async_save=False,
+                            max_restore_fallbacks=0)
+    _save_steps(mgr, state, [0, 1])
+    _corrupt_step_dir(tmp_path / "1")
+    with pytest.raises(ValueError):
+        mgr.restore(state)
+    assert (tmp_path / "1").exists()  # nothing quarantined
+    mgr.close()
+
+
+def test_structural_mismatch_never_quarantines(tmp_path, state, monkeypatch):
+    """The fallback ladder is for READ corruption only: a structural
+    KeyError (healing ladder territory) must not eat checkpoints."""
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    _save_steps(mgr, state, [0, 1])
+    monkeypatch.setattr(
+        mgr, "_restore_step",
+        lambda *a, **k: (_ for _ in ()).throw(KeyError("params.missing")))
+    with pytest.raises(KeyError):
+        mgr.restore(state)
+    assert (tmp_path / "1").exists() and not (tmp_path / "quarantine").exists()

@@ -1,0 +1,598 @@
+"""Fault-injection and resilience subsystem (dist_mnist_tpu/faults/).
+
+The reference validated preemption recovery by hand-raising AbortedError
+into _RecoverableSession in unit tests (SURVEY.md §4) and tested nothing
+at the launch or checkpoint layers. Here every recovery path is reachable
+on purpose through a seeded `FaultPlan`, and the headline invariant is
+BIT-IDENTICAL trajectories: a recovered run must produce exactly the
+per-step losses of the fault-free run (restore + re-seek + replay, never
+skip), so resilience cannot silently perturb the math.
+
+Fast tests (tier-1): classifier pins, plan serialization, goodput clock,
+the in-process preemption handshake, recovery trajectory identity, the
+serve-engine fault, and the supervisor restart ladder driven by a jax-free
+stub child (launch/backoff/exit-code semantics in ~a second). Slow tests:
+SIGTERM against a real `cli.train` process and the 2-process kill-injection
+integration (each child pays the jax import + compile).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from dist_mnist_tpu.faults import (
+    Fault,
+    FaultPlan,
+    FaultyBatches,
+    GoodputClock,
+    PreemptionNotice,
+    install_preemption_handlers,
+)
+from dist_mnist_tpu.train.loop import PreemptionError, TrainLoop, _is_preemption
+
+
+# -- satellite: _is_preemption classification pins ---------------------------
+
+def test_preemption_error_classifies():
+    assert _is_preemption(PreemptionError("injected"))
+
+
+def test_value_error_mentioning_preempt_is_NOT_preemption():
+    # the exact bug the tightened classifier defends: an application
+    # ValueError whose MESSAGE contains "preempt" must not buy a silent
+    # checkpoint restore (type is checked before status substrings)
+    assert not _is_preemption(ValueError("user config: preempt_margin=3"))
+    assert not _is_preemption(RuntimeError("UNAVAILABLE: socket closed"))
+
+
+def test_xla_runtime_error_status_substrings():
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    assert _is_preemption(XlaRuntimeError("UNAVAILABLE: socket closed"))
+    assert _is_preemption(XlaRuntimeError("ABORTED: coordination service"))
+    assert _is_preemption(XlaRuntimeError("slice preempted by scheduler"))
+    # right type, unrelated status: not a preemption
+    assert not _is_preemption(XlaRuntimeError("INVALID_ARGUMENT: shape"))
+
+
+# -- FaultPlan: construction + (de)serialization -----------------------------
+
+def test_plan_json_roundtrip(tmp_path):
+    plan = FaultPlan(
+        [
+            Fault.preempt(8),
+            Fault.corrupt_checkpoint(6, mode="delete"),
+            Fault.stall_input(2, 0.25),
+            Fault.kill_process(1, after_s=3.0),
+            Fault.serve_error(request=4),
+        ],
+        seed=7,
+    )
+    back = FaultPlan.from_json(plan.to_json())
+    assert back.seed == 7
+    assert [f.to_dict() for f in back.faults] == [f.to_dict() for f in plan.faults]
+    assert back.kill_spec() == (1, 3.0)
+
+    # --fault_plan accepts inline JSON or a file path
+    inline = FaultPlan.from_spec(plan.to_json())
+    assert inline.kill_spec() == (1, 3.0)
+    p = tmp_path / "plan.json"
+    p.write_text(plan.to_json())
+    assert FaultPlan.from_spec(str(p)).kill_spec() == (1, 3.0)
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        Fault("meteor_strike")
+
+
+def test_fired_latch_consumes_fault():
+    plan = FaultPlan([Fault.preempt(3)])
+    (f,) = plan.pending("preempt")
+    f.fired = True
+    assert plan.pending("preempt") == []
+    assert plan.fired() == [f]
+
+
+def test_wiring_helpers_are_noops_without_matching_faults():
+    plan = FaultPlan([Fault.preempt(3)])
+    sentinel = object()
+    assert plan.wrap_batches(sentinel) is sentinel
+    assert plan.wrap_checkpoint_manager(sentinel) is sentinel
+    assert plan.wrap_engine(sentinel) is sentinel
+    assert plan.wrap_checkpoint_manager(None) is None
+
+
+# -- GoodputClock ------------------------------------------------------------
+
+def test_goodput_clock_buckets_and_events():
+    g = GoodputClock()
+    g.start()
+    g.add_productive(2.0)
+    g.add_stall(0.5)
+    g.begin_recovery(failed_at_step=10, restored_step=6, restore_s=1.0)
+    assert g.in_replay
+    g.note_replay(0.3, 2, at_step=8)
+    assert g.in_replay
+    g.note_replay(0.3, 2, at_step=10)  # frontier regained -> event closes
+    assert not g.in_replay
+    g.close()
+    snap = g.snapshot()
+    assert snap["recoveries"] == 1
+    assert snap["replayed_steps"] == 4
+    assert snap["restore_s"] == pytest.approx(1.0)
+    assert snap["replay_s"] == pytest.approx(0.6)
+    assert snap["recovery_latency_ms"] == pytest.approx(1600.0)
+    (ev,) = g.events
+    assert ev["complete"] and ev["failed_at_step"] == 10 and ev["restored_step"] == 6
+
+
+def test_goodput_close_freezes_incomplete_recovery():
+    g = GoodputClock()
+    g.start()
+    g.begin_recovery(failed_at_step=5, restored_step=2, restore_s=0.1)
+    g.close()
+    (ev,) = g.events
+    assert not ev["complete"]  # run ended mid-replay; reported honestly
+    assert g.snapshot()["goodput_fraction"] >= 0.0
+
+
+# -- preemption handshake ----------------------------------------------------
+
+def test_signal_sets_notice_and_second_signal_escalates():
+    notice = PreemptionNotice()
+    escalated = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: escalated.append(s))
+    try:
+        uninstall = install_preemption_handlers(notice, signals=(signal.SIGUSR1,))
+        signal.raise_signal(signal.SIGUSR1)
+        assert notice.requested()
+        assert notice.reason == "signal SIGUSR1"
+        assert not escalated
+        # second signal: previous disposition restored and re-raised
+        signal.raise_signal(signal.SIGUSR1)
+        assert escalated == [signal.SIGUSR1]
+        uninstall()  # idempotent even after the handler un-installed itself
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_uninstall_restores_previous_handler():
+    notice = PreemptionNotice()
+    prev = signal.getsignal(signal.SIGUSR2)
+    uninstall = install_preemption_handlers(notice, signals=(signal.SIGUSR2,))
+    assert signal.getsignal(signal.SIGUSR2) is not prev
+    uninstall()
+    assert signal.getsignal(signal.SIGUSR2) is prev
+
+
+# -- in-process training harness ---------------------------------------------
+
+class _Trajectory:
+    """Per-step loss recorder; device scalars fetched once at `end`."""
+
+    def __init__(self):
+        self.loss = {}
+
+    def begin(self, loop):
+        pass
+
+    def before_step(self, step):
+        pass
+
+    def after_step(self, step, state, outputs):
+        self.loss[step] = outputs["loss"]
+
+    def end(self, state):
+        import jax
+
+        self.loss = {k: np.asarray(jax.device_get(v))
+                     for k, v in self.loss.items()}
+
+
+def _run_training(mesh, dataset, *, n_steps=12, ckpt_dir=None, ckpt_every=3,
+                  plan=None, preemption=None, extra_hooks=(),
+                  max_restore_fallbacks=1):
+    """One short mlp training run; returns (trajectory dict, loop)."""
+    import jax
+
+    from dist_mnist_tpu import hooks as hooks_lib, optim
+    from dist_mnist_tpu.checkpoint import CheckpointManager
+    from dist_mnist_tpu.cluster.mesh import activate
+    from dist_mnist_tpu.data import ShardedBatcher
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state
+    from dist_mnist_tpu.train.step import make_train_step
+
+    with activate(mesh):
+        model = get_model("mlp", hidden_units=16)
+        optimizer = optim.adam(1e-3)
+        state = create_train_state(
+            model, optimizer, jax.random.PRNGKey(0), dataset.train_images[:1])
+        state = shard_train_state(state, mesh)
+        step = make_train_step(model, optimizer, mesh, donate=False)
+
+        traj = _Trajectory()
+        hooks = [hooks_lib.StopAtStepHook(last_step=n_steps), traj,
+                 *extra_hooks]
+        manager = None
+        if ckpt_dir is not None:
+            manager = CheckpointManager(
+                ckpt_dir, async_save=False,
+                max_restore_fallbacks=max_restore_fallbacks)
+            if plan is not None:
+                manager = plan.wrap_checkpoint_manager(manager)
+            hooks.append(hooks_lib.CheckpointHook(manager, every_steps=ckpt_every))
+        batches = ShardedBatcher(dataset, 64, mesh, seed=0)
+        if plan is not None:
+            hooks.append(plan.hook())
+            batches = plan.wrap_batches(batches)
+        loop = TrainLoop(step, state, batches, hooks,
+                         checkpoint_manager=manager, max_recoveries=3,
+                         preemption=preemption)
+        loop.run()
+        if manager is not None:
+            manager.close()
+    return traj.loss, loop
+
+
+def _assert_identical(clean: dict, faulted: dict):
+    assert set(clean) == set(faulted)
+    for s in clean:
+        assert clean[s].tobytes() == faulted[s].tobytes(), (
+            f"loss diverged at step {s}: {clean[s]!r} != {faulted[s]!r}")
+
+
+def test_notice_stops_loop_at_boundary_with_checkpoint(mesh8, small_mnist,
+                                                       tmp_path):
+    """The in-process handshake: notify mid-run -> the loop checkpoints at
+    the next step boundary, records `preempted_at`, and stops cleanly."""
+    notice = PreemptionNotice()
+
+    class NotifyAt:
+        def begin(self, loop):
+            pass
+
+        def before_step(self, step):
+            pass
+
+        def after_step(self, step, state, outputs):
+            if step == 4:
+                notice.notify("test preemption")
+
+        def end(self, state):
+            pass
+
+    traj, loop = _run_training(
+        mesh8, small_mnist, n_steps=12, ckpt_dir=tmp_path / "ckpt",
+        preemption=notice, extra_hooks=(NotifyAt(),))
+    assert loop.preempted_at == 4
+    assert loop.stop.reason == "preempted@step=4"
+    assert max(traj) == 4  # no step ran past the boundary
+    from dist_mnist_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.latest_step() == 4  # durable before the stop
+    mgr.close()
+
+
+def test_preempt_recovery_trajectory_bit_identical(mesh8, small_mnist,
+                                                   tmp_path):
+    """Injected preemption at step 8 -> restore latest (6), replay 2 steps;
+    the recovered trajectory is bit-identical to the fault-free run."""
+    clean, _ = _run_training(mesh8, small_mnist, n_steps=12)
+
+    plan = FaultPlan([Fault.preempt(8)])
+    faulted, loop = _run_training(
+        mesh8, small_mnist, n_steps=12, ckpt_dir=tmp_path / "ckpt", plan=plan)
+
+    _assert_identical(clean, faulted)
+    assert all(f.fired for f in plan.faults)
+    snap = loop.goodput.snapshot()
+    assert snap["recoveries"] == 1
+    assert snap["replayed_steps"] == 2  # restored@6, frontier was 8
+    (ev,) = loop.goodput.events
+    assert ev["complete"] and ev["failed_at_step"] == 8 and ev["restored_step"] == 6
+    assert snap["recovery_latency_ms"] > 0
+    assert 0.0 < snap["goodput_fraction"] <= 1.0
+
+
+def test_combined_preempt_corrupt_stall_chain(mesh8, small_mnist, tmp_path):
+    """The acceptance chain: preemption at 8 AND the checkpoint it wants
+    (6) corrupted AND an input stall — restore quarantines step 6, falls
+    back to 3, replays 5 steps, and the trajectory is still bit-identical."""
+    clean, _ = _run_training(mesh8, small_mnist, n_steps=12)
+
+    ckpt = tmp_path / "ckpt"
+    plan = FaultPlan([
+        Fault.preempt(8),
+        Fault.corrupt_checkpoint(6),
+        Fault.stall_input(2, 0.05),
+    ])
+    faulted, loop = _run_training(
+        mesh8, small_mnist, n_steps=12, ckpt_dir=ckpt, plan=plan,
+        max_restore_fallbacks=2)
+
+    _assert_identical(clean, faulted)
+    assert sorted(f.kind for f in plan.fired()) == [
+        "corrupt_checkpoint", "preempt", "stall_input"]
+    assert (ckpt / "quarantine" / "step_6").exists()
+    # step 6 exists again on disk: the REPLAY re-saved it (healthy — the
+    # manager stayed writable after the quarantine)
+    assert (ckpt / "6").exists()
+    snap = loop.goodput.snapshot()
+    assert snap["recoveries"] == 1
+    assert snap["replayed_steps"] == 5  # restored@3 after the fallback
+    assert snap["stall_s"] >= 0.05
+    (ev,) = loop.goodput.events
+    assert ev["restored_step"] == 3 and ev["failed_at_step"] == 8
+
+
+# -- FaultyBatches (jax-free) ------------------------------------------------
+
+class _ListBatches:
+    def __init__(self, items, start=0):
+        self.items = items
+        self.start = start
+
+    def at_step(self, step):
+        return _ListBatches(self.items, start=step)
+
+    def __iter__(self):
+        return iter(self.items[self.start:])
+
+
+def test_faulty_batches_stalls_then_delegates():
+    plan = FaultPlan([Fault.stall_input(1, 0.05)])
+    fb = FaultyBatches(_ListBatches([10, 11, 12]), plan)
+    t0 = time.monotonic()
+    assert list(fb) == [10, 11, 12]
+    assert time.monotonic() - t0 >= 0.05
+    assert plan.faults[0].fired  # at-most-once: a re-iteration won't stall
+    t0 = time.monotonic()
+    assert list(fb) == [10, 11, 12]
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_faulty_batches_reseek_preserves_wrapper():
+    plan = FaultPlan([Fault.stall_input(99, 0.01)])
+    fb = FaultyBatches(_ListBatches([10, 11, 12]), plan)
+    fb2 = fb.at_step(2)
+    assert isinstance(fb2, FaultyBatches)
+    assert list(fb2) == [12]
+    assert fb2._plan is plan  # same latches across the re-seek
+
+
+# -- serve-engine fault ------------------------------------------------------
+
+def test_serve_error_fails_one_batch_keeps_serving(mesh8):
+    from dist_mnist_tpu.serve import (
+        InferenceEngine, InferenceServer, ServeConfig, load_for_serving)
+
+    bundle = load_for_serving("mlp_mnist", mesh8)
+    engine = InferenceEngine(
+        bundle.model, bundle.params, bundle.model_state, mesh8,
+        model_name="mlp-faults", image_shape=bundle.image_shape,
+        rules=bundle.rules, max_bucket=16,
+    )
+    plan = FaultPlan([Fault.serve_error(request=0)])
+    faulty = plan.wrap_engine(engine)
+    assert faulty is not engine  # wired (pending serve_error present)
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=bundle.image_shape, dtype=np.uint8)
+    server = InferenceServer(faulty, ServeConfig(
+        max_batch=8, max_wait_ms=2.0, queue_depth=16, prewarm=False))
+    with server:
+        f1 = server.submit(img)
+        with pytest.raises(RuntimeError, match="injected serve engine error"):
+            f1.result(timeout=30)
+        # the batcher failed ONLY that batch's futures; the next request
+        # must be served (fired latch: the fault does not re-raise)
+        f2 = server.submit(img)
+        assert f2.result(timeout=30).logits.shape == (10,)
+    assert plan.faults[0].fired
+
+
+# -- supervisor: stub-child restart ladder -----------------------------------
+
+STUB_CHILD = textwrap.dedent("""\
+    import os, sys, time
+
+    args = dict(a.split("=", 1) for a in sys.argv[1:]
+                if a.startswith("--") and "=" in a)
+    pid = int(args.get("--process_id", "0"))
+    mode = args.get("--stub_mode", "ok")
+    if pid == 0:
+        chief_rc = int(args.get("--stub_chief_rc", "0"))
+        time.sleep(float(args.get("--stub_chief_sleep", "0.5")))
+        sys.exit(chief_rc)
+    if mode == "fail_once":
+        marker = args["--stub_marker"]
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(3)
+        sys.exit(0)
+    if mode == "fail_always":
+        sys.exit(7)
+    time.sleep(float(args.get("--stub_sleep", "0")))
+    sys.exit(0)
+""")
+
+
+@pytest.fixture()
+def stub_child(tmp_path):
+    """A jax-free child program so supervisor semantics (restart, backoff,
+    exit codes, kill injection) are testable in ~a second."""
+    path = tmp_path / "stub_child.py"
+    path.write_text(STUB_CHILD)
+    return [sys.executable, str(path)]
+
+
+def _supervise(stub_child, train_args, **kw):
+    from dist_mnist_tpu.cli.launch import launch
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = launch(2, train_args, platform="cpu", devices_per_process=1,
+                    child_command=stub_child, restart_backoff_s=0.05, **kw)
+    return rc, buf.getvalue()
+
+
+def test_supervisor_restarts_failed_worker(stub_child, tmp_path):
+    rc, log = _supervise(
+        stub_child,
+        ["--stub_mode=fail_once", f"--stub_marker={tmp_path / 'marker'}"],
+        max_restarts=2,
+    )
+    assert rc == 0, log
+    # satellite: the error names the dead worker's tag AND exit code
+    assert "p1 exited rc=3" in log
+    assert "restarting cluster (attempt 1/2)" in log
+
+
+def test_supervisor_gives_up_with_childs_rc(stub_child):
+    rc, log = _supervise(stub_child, ["--stub_mode=fail_always"],
+                         max_restarts=1)
+    assert rc == 7, log  # deterministic: the dead child's own exit code
+    assert "p1 exited rc=7" in log
+    assert "giving up after 1 restart(s)" in log
+
+
+def test_supervisor_fail_fast_without_restarts(stub_child):
+    rc, log = _supervise(stub_child, ["--stub_mode=fail_always"],
+                         max_restarts=0)
+    assert rc == 7, log
+    assert "restarting" not in log
+
+
+def test_supervisor_kill_injection_then_clean_restart(stub_child):
+    rc, log = _supervise(
+        stub_child,
+        ["--stub_mode=sleep", "--stub_sleep=2.0", "--stub_chief_sleep=2.0"],
+        max_restarts=1, kill_spec=(1, 0.3),
+    )
+    assert rc == 0, log
+    assert "fault injected: SIGKILL p1" in log
+    assert "p1 exited rc=137 (killed by SIGKILL)" in log
+    # the kill fires only in generation 0; the restarted cluster completes
+    assert "restarting cluster (attempt 1/1)" in log
+
+
+def test_supervisor_chief_death_is_fatal(stub_child):
+    rc, log = _supervise(
+        stub_child,
+        ["--stub_chief_rc=5", "--stub_chief_sleep=0.1",
+         "--stub_mode=sleep", "--stub_sleep=2.0"],
+        max_restarts=3,
+    )
+    assert rc == 5, log
+    assert "chief died" in log
+    assert "restarting cluster" not in log  # chief state is unrecoverable
+
+
+# -- slow: real-process integration ------------------------------------------
+
+@pytest.mark.slow
+def test_sigterm_checkpoints_and_exits_zero(tmp_path):
+    """SIGTERM to a real training process -> checkpoint at the boundary
+    step, `preempted@step=N` marker, exit code 0 (the acceptance handshake)."""
+    data_dir = str(tmp_path / "data")
+    ckpt_dir = tmp_path / "ckpt"
+    r = subprocess.run(
+        [sys.executable, "-m", "dist_mnist_tpu.cli.train",
+         "--download_only", f"--data_dir={data_dir}",
+         "--config=mlp_mnist", "--platform=cpu"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dist_mnist_tpu.cli.train",
+         "--config=mlp_mnist", f"--data_dir={data_dir}",
+         f"--checkpoint_dir={ckpt_dir}", "--platform=cpu",
+         "--train_steps=100000", "--batch_size=32", "--eval_every=0",
+         "--log_every=5"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    lines = []
+    try:
+        deadline = time.monotonic() + 240
+        # wait until training demonstrably progresses...
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if re.search(r"step \d+: ", line):
+                break
+        else:
+            pytest.fail("no training progress before deadline")
+        # ...then preempt it
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=240)
+        lines.append(out)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    log = "".join(lines)
+    assert proc.returncode == 0, log
+    m = re.search(r"preempted@step=(\d+)", log)
+    assert m, log
+    step = int(m.group(1))
+    from dist_mnist_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(ckpt_dir)
+    assert mgr.latest_step() == step  # durable at the boundary step
+    mgr.close()
+
+
+@pytest.mark.slow
+def test_launch_kill_injection_training_completes(tmp_path):
+    """Acceptance: one killed non-chief process under the supervisor ->
+    cluster restarts and training still completes all steps, with both
+    processes agreeing on the final accuracy."""
+    from dist_mnist_tpu.cli.launch import launch
+
+    data_dir = str(tmp_path / "data")
+    r = subprocess.run(
+        [sys.executable, "-m", "dist_mnist_tpu.cli.train",
+         "--download_only", f"--data_dir={data_dir}",
+         "--config=mlp_mnist", "--platform=cpu"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = launch(
+            2,
+            ["--config=mlp_mnist", f"--data_dir={data_dir}",
+             f"--checkpoint_dir={tmp_path / 'ckpt'}",
+             "--train_steps=6", "--batch_size=32", "--eval_every=0",
+             "--log_every=2"],
+            platform="cpu", devices_per_process=1,
+            max_restarts=2, restart_backoff_s=0.2, kill_spec=(1, 5.0),
+        )
+    log = buf.getvalue()
+    assert rc == 0, log
+    assert "fault injected: SIGKILL p1" in log
+    assert "p1 exited rc=137" in log
+    assert "restarting cluster" in log
+    finals = re.findall(r"\[p(\d)\].*done: step=(\d+) test_acc=([0-9.]+)", log)
+    assert sorted(f[0] for f in finals) == ["0", "1"], log
+    assert all(f[1] == "6" for f in finals), finals
+    assert finals[0][2] == finals[1][2], finals
