@@ -47,6 +47,11 @@ import subprocess
 import sys
 import time
 
+#: process-start anchor for the --coldstart-child startup attribution
+#: (compilecache/StartupClock): bench's own import is stdlib-cheap, so the
+#: child's jax import lands in the ``init`` bucket where it belongs
+_T0 = time.monotonic()
+
 HEADLINE_METRIC = "lenet5_mnist_steps_per_sec_per_chip"
 
 #: merged into every emitted record by `emit` — the CPU-fallback probe
@@ -126,13 +131,69 @@ def _probe_timeout_s(default_s: int) -> int:
         return default_s
 
 
+def _probe_cache_key() -> str:
+    """Verdicts are per requested platform: the TPU-then-cpu-fallback
+    sequence (probe_backend_with_fallback) caches BOTH outcomes under
+    distinct keys, so later stages replay the same two-phase decision."""
+    return os.environ.get("JAX_PLATFORMS", "").strip() or "default"
+
+
+def _probe_cache_read() -> list[str] | None:
+    """Cached probe verdict for the current platform key from the file
+    named by `BENCH_PROBE_CACHE`, or None when uncached/unset/unreadable.
+    A verdict is [] (backend up) or the error list the probing stage saw.
+    measure_all.sh points every stage of one run at the same file, so the
+    ~N x (probe subprocess or, on a down relay, N x BENCH_PROBE_TIMEOUT_S)
+    cost is paid once per run instead of once per stage."""
+    path = os.environ.get("BENCH_PROBE_CACHE", "").strip()
+    if not path:
+        return None
+    try:
+        with open(path) as fh:
+            verdicts = json.load(fh)
+        v = verdicts.get(_probe_cache_key())
+        return [str(e) for e in v] if isinstance(v, list) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _probe_cache_write(errs: list[str]) -> None:
+    path = os.environ.get("BENCH_PROBE_CACHE", "").strip()
+    if not path:
+        return
+    try:
+        try:
+            with open(path) as fh:
+                verdicts = json.load(fh)
+            if not isinstance(verdicts, dict):
+                verdicts = {}
+        except (OSError, ValueError):
+            verdicts = {}
+        verdicts[_probe_cache_key()] = errs
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(verdicts, fh)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # verdict cache is an optimization, never a failure source
+
+
 def _probe(retries: int, timeout_s: int) -> list[str]:
     """Bounded out-of-process backend probe; [] on success, else the error
     per attempt. A hung/down TPU tunnel makes `import jax; jax.devices()`
     block or die IN-PROCESS — exactly what produced round 1's unparseable
     bench. Probing in a subprocess bounds the blast radius; retries cover
     transient tunnel restarts. Connection-refused-class failures short-
-    circuit the remaining attempts (nothing transient about a dead relay)."""
+    circuit the remaining attempts (nothing transient about a dead relay).
+
+    With `BENCH_PROBE_CACHE` set, a verdict already recorded for this
+    platform key is returned without probing at all."""
+    cached = _probe_cache_read()
+    if cached is not None:
+        if not cached:
+            return []
+        return [*cached[:-1],
+                cached[-1] + " [cached verdict: BENCH_PROBE_CACHE]"]
     timeout_s = _probe_timeout_s(timeout_s)
     errs = []
     for attempt in range(retries):
@@ -144,6 +205,7 @@ def _probe(retries: int, timeout_s: int) -> list[str]:
                 capture_output=True, text=True, timeout=timeout_s,
             )
             if out.returncode == 0 and "DEVCOUNT" in out.stdout:
+                _probe_cache_write([])
                 return []
             errs.append(f"rc={out.returncode}: {out.stderr.strip()[-300:]}")
         except subprocess.TimeoutExpired:
@@ -153,6 +215,7 @@ def _probe(retries: int, timeout_s: int) -> list[str]:
             break
         if attempt < retries - 1:
             time.sleep(min(30, 5 * 2 ** attempt))
+    _probe_cache_write(errs)
     return errs
 
 
@@ -709,6 +772,164 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
     return 0
 
 
+def coldstart_child(cache_dir: str, n_steps: int) -> int:
+    """One measured process of the cold/warm pair (`--coldstart-child`):
+    build the LeNet-5 training step against the warm-start cache in
+    `cache_dir` (compilecache/), run `n_steps` deterministic steps, and
+    print one JSON line — time-to-first-step, the StartupClock buckets,
+    the ExecutableStore stats, and the loss trajectory as exact float32
+    hex so the parent can assert bit-identity across the pair. The conv
+    model is chosen deliberately: its XLA-CPU compile is seconds, so the
+    cold-vs-warm gap dwarfs any load-time noise."""
+    apply_platform_override()
+    from pathlib import Path
+
+    from dist_mnist_tpu.compilecache import (
+        ExecutableStore,
+        StartupClock,
+        cache_key,
+        enable_persistent_cache,
+    )
+
+    clock = StartupClock(t0=_T0)
+    clock.note("import", time.monotonic() - _T0)
+    with clock.phase("init"):
+        import jax
+        import numpy as np
+
+        from dist_mnist_tpu import optim
+        from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
+        from dist_mnist_tpu.data import ShardedBatcher, load_dataset
+        from dist_mnist_tpu.models import get_model
+        from dist_mnist_tpu.parallel.sharding import shard_train_state
+        from dist_mnist_tpu.train import create_train_state
+        from dist_mnist_tpu.train.step import make_train_step
+
+        root = Path(cache_dir)
+        enable_persistent_cache(root / "xla")
+        store = ExecutableStore(root / "exe")
+        mesh = make_mesh(MeshSpec(data=-1))
+        batch = 16 * mesh.devices.size
+        dataset = load_dataset("mnist", "/tmp/mnist-data", seed=0)
+        model = get_model("lenet5")
+        optimizer = optim.adam(1e-3)
+        key = cache_key({
+            "kind": "coldstart", "model": "lenet5", "batch": batch,
+            "mesh": tuple(sorted(mesh.shape.items())), "sharding": "dp",
+            "dtype": "float32", "donate": False,
+        })
+    with activate(mesh):
+        with clock.phase("init"):
+            state = create_train_state(
+                model, optimizer, jax.random.PRNGKey(0),
+                dataset.train_images[:1]
+            )
+            state = shard_train_state(state, mesh)
+            # donate=False: cold and warm must consume identical buffers
+            step = make_train_step(model, optimizer, mesh, donate=False,
+                                   store=store, cache_key=key)
+            batches = ShardedBatcher(dataset, batch, mesh, seed=0)
+        it = iter(batches)
+        losses = []
+        state, out = step(state, next(it))
+        jax.device_get(out["loss"])  # fence: the step actually finished
+        clock.first_step_done()
+        # compile-or-load attribution AFTER the freeze: first_step is the
+        # residual at snapshot time, so this never double-counts
+        clock.note("compile", step.consume_compile_s())
+        losses.append(out["loss"])
+        for _ in range(n_steps - 1):
+            state, out = step(state, next(it))
+            losses.append(out["loss"])
+        traj = [np.asarray(jax.device_get(l), dtype=np.float32).tobytes().hex()
+                for l in losses]
+    snap = clock.snapshot()
+    print(json.dumps({
+        "time_to_first_step_ms": snap["time_to_first_step_ms"],
+        "startup": snap,
+        "cache": store.stats(),
+        "tier": step.cache_stats["tier"],
+        "losses": traj,
+    }), flush=True)
+    return 0
+
+
+def bench_coldstart(n_steps: int = 20, *, child_timeout_s: int = 600) -> int:
+    """Cold-start mode (`--coldstart`): run the SAME short training job in
+    two fresh processes sharing one warm-start cache directory — the first
+    cold (compiles, saves), the second warm (deserializes the executable
+    the first saved). Emits `time_to_first_step_ms` (the WARM number, the
+    one a supervisor restart pays) with the cold number and
+    `restart_compile_saved_ms` alongside; asserts the warm process hit the
+    cache, beat the cold time, and produced a bit-identical trajectory."""
+    import shutil
+    import tempfile
+
+    metric = "time_to_first_step_ms"
+    pair_dir = tempfile.mkdtemp(prefix="bench_coldstart_")
+
+    def run_child(tag: str) -> dict:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             f"--coldstart-child={pair_dir}",
+             f"--coldstart-steps={n_steps}"],
+            capture_output=True, text=True, timeout=child_timeout_s,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"{tag} coldstart child rc={out.returncode}: "
+                f"{out.stderr.strip()[-400:]}")
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+        raise RuntimeError(f"{tag} coldstart child printed no JSON line")
+
+    try:
+        cold = run_child("cold")
+        warm = run_child("warm")
+    finally:
+        shutil.rmtree(pair_dir, ignore_errors=True)
+
+    assert warm["cache"]["hits"] > 0, (
+        f"warm process missed the executable store: {warm['cache']}")
+    assert warm["losses"] == cold["losses"], (
+        "warm trajectory diverged from cold — the deserialized executable "
+        "is not the program that was saved")
+    cold_ms = cold["time_to_first_step_ms"]
+    warm_ms = warm["time_to_first_step_ms"]
+    assert warm_ms < cold_ms, (
+        f"warm start ({warm_ms:.0f} ms) not faster than cold "
+        f"({cold_ms:.0f} ms)")
+    emit({
+        "metric": metric,
+        "value": round(warm_ms, 1),
+        "unit": "ms",
+        "vs_baseline": 0.0,  # startup metric: no published reference
+        "extra": {
+            "cold_ms": round(cold_ms, 1),
+            "warm_ms": round(warm_ms, 1),
+            # compile wall time the warm process did not pay, as recorded
+            # by the cold process when it saved the entry
+            "restart_compile_saved_ms": round(
+                warm["cache"]["compile_ms_saved"], 1),
+            "ttfs_saved_ms": round(cold_ms - warm_ms, 1),
+            "steps": n_steps,
+            "trajectory_identical": True,
+            "warm_tier": warm.get("tier"),
+            "cold_startup": {k: round(v, 1)
+                             for k, v in cold["startup"].items()},
+            "warm_startup": {k: round(v, 1)
+                             for k, v in warm["startup"].items()},
+            "warm_cache": {k: (round(v, 2) if isinstance(v, float) else v)
+                           for k, v in warm["cache"].items()},
+            **_anchor_fields(metric, warm_ms),
+        },
+    })
+    return 0
+
+
 def _mem_stats_dict(ma) -> dict | None:
     """CompiledMemoryStats -> plain dict of the byte fields this jax
     version exposes (field set varies across versions); None when the
@@ -918,6 +1139,16 @@ if __name__ == "__main__":
                          "recovery latency, goodput fraction, and a "
                          "bit-identical-trajectory check "
                          "(recovery_latency_ms)")
+    ap.add_argument("--coldstart", action="store_true", dest="coldstart_mode",
+                    help="cold-start mode: run the same short training job "
+                         "in a cold process then a warm one sharing a "
+                         "compile-cache dir; reports warm "
+                         "time_to_first_step_ms + restart_compile_saved_ms "
+                         "and asserts a bit-identical trajectory")
+    ap.add_argument("--coldstart-child", default=None, metavar="CACHE_DIR",
+                    help=argparse.SUPPRESS)  # internal: one measured process
+    ap.add_argument("--coldstart-steps", type=int, default=20,
+                    help="steps per process in --coldstart mode")
     ap.add_argument("--requests", type=int, default=512,
                     help="loadgen request count in --serve mode")
     ap.add_argument("--concurrency", type=int, default=64,
@@ -926,10 +1157,16 @@ if __name__ == "__main__":
                     help="hard wall-clock bound; a structured JSON error "
                          "line is printed if exceeded")
     args = ap.parse_args()
+    if args.coldstart_child:
+        # measured child of --coldstart: no probe (the parent probed), no
+        # deadline (the parent bounds it), raw traceback on failure (the
+        # parent wraps it into ITS structured line)
+        sys.exit(coldstart_child(args.coldstart_child, args.coldstart_steps))
     metric = ("serve_p99_latency_ms" if args.serve
               else "input_stall_ms_per_step" if args.input_mode
               else "fsdp_per_device_state_bytes" if args.memory_mode
               else "recovery_latency_ms" if args.faults_mode
+              else "time_to_first_step_ms" if args.coldstart_mode
               else f"{args.config}_steps_per_sec_per_chip" if args.config
               else HEADLINE_METRIC)
 
@@ -952,6 +1189,8 @@ if __name__ == "__main__":
                  if args.input_mode
                  else bench_memory(args.config) if args.memory_mode
                  else bench_faults() if args.faults_mode
+                 else bench_coldstart(args.coldstart_steps)
+                 if args.coldstart_mode
                  else bench_config(args.config, args.steps) if args.config
                  else main())
     except Exception as e:  # noqa: BLE001 — the contract is ONE JSON line, always

@@ -304,6 +304,7 @@ def launch(
     restart_backoff_s: float = 1.0,
     kill_spec: tuple[int, float] | None = None,
     child_command: list[str] | None = None,
+    compile_cache_dir: str | None = None,
 ) -> int:
     """Spawn the cluster; return 0 or a deterministic nonzero exit status
     (the first abnormal death's, signal deaths normalized to 128+N).
@@ -317,31 +318,58 @@ def launch(
     fresh each time. Backoff is exponential with jitter. A chief (p0)
     death is fatal: the chief owns the coordination service, so its loss
     says the job itself — not one replica — is broken. An operator
-    interrupt (Ctrl-C) is never "restarted"."""
+    interrupt (Ctrl-C) is never "restarted".
+
+    A supervised cluster also gets a WARM-START cache: every generation
+    receives the same ``--compile_cache_dir`` (compilecache/), so
+    generation N+1 deserializes the step programs generation N compiled
+    instead of paying the cold compile again — the recurring compile cost
+    the restart loop would otherwise multiply. If the caller didn't pick a
+    directory, the supervisor creates a private one and removes it when
+    the job ends; an explicit dir (flag or train_args) is left alone."""
+    cache_dir_owned = False
+    if max_restarts > 0 and compile_cache_dir is None and not any(
+        a.startswith("--compile_cache_dir") for a in train_args
+    ):
+        compile_cache_dir = tempfile.mkdtemp(prefix="dist_mnist_warmstart_")
+        cache_dir_owned = True
+        _say(f"[supervisor] warm-start cache for restart generations: "
+             f"{compile_cache_dir}")
+    if compile_cache_dir is not None and not any(
+        a.startswith("--compile_cache_dir") for a in train_args
+    ):
+        train_args = [*train_args, f"--compile_cache_dir={compile_cache_dir}"]
     rng = random.Random(0)  # deterministic jitter (tests time the backoff)
     attempt = 0
-    while True:
-        rc, failure, first_dead = _launch_once(
-            num_processes, train_args, port=port, platform=platform,
-            devices_per_process=devices_per_process, env_extra=env_extra,
-            kill_spec=kill_spec if attempt == 0 else None,
-            child_command=child_command,
-        )
-        if rc == 0 or failure is None or max_restarts <= 0:
-            return rc
-        if first_dead == 0:
-            _say(f"[supervisor] chief died ({failure}); fatal — "
-                 f"not restarting, rc={rc}")
-            return rc
-        if attempt >= max_restarts:
-            _say(f"[supervisor] {failure}; giving up after {attempt} "
-                 f"restart(s), rc={rc}")
-            return rc
-        delay = restart_backoff_s * (2 ** attempt) * (1.0 + 0.5 * rng.random())
-        attempt += 1
-        _say(f"[supervisor] {failure}; restarting cluster "
-             f"(attempt {attempt}/{max_restarts}) in {delay:.2f}s")
-        time.sleep(delay)
+    try:
+        while True:
+            rc, failure, first_dead = _launch_once(
+                num_processes, train_args, port=port, platform=platform,
+                devices_per_process=devices_per_process, env_extra=env_extra,
+                kill_spec=kill_spec if attempt == 0 else None,
+                child_command=child_command,
+            )
+            if rc == 0 or failure is None or max_restarts <= 0:
+                return rc
+            if first_dead == 0:
+                _say(f"[supervisor] chief died ({failure}); fatal — "
+                     f"not restarting, rc={rc}")
+                return rc
+            if attempt >= max_restarts:
+                _say(f"[supervisor] {failure}; giving up after {attempt} "
+                     f"restart(s), rc={rc}")
+                return rc
+            delay = (restart_backoff_s * (2 ** attempt)
+                     * (1.0 + 0.5 * rng.random()))
+            attempt += 1
+            _say(f"[supervisor] {failure}; restarting cluster "
+                 f"(attempt {attempt}/{max_restarts}) in {delay:.2f}s")
+            time.sleep(delay)
+    finally:
+        if cache_dir_owned:
+            import shutil
+
+            shutil.rmtree(compile_cache_dir, ignore_errors=True)
 
 
 #: launcher-owned / per-child flags that must NOT be blanket-forwarded
@@ -391,6 +419,7 @@ def main(argv):
         max_restarts=FLAGS.max_restarts,
         restart_backoff_s=FLAGS.restart_backoff_s,
         kill_spec=kill_spec,
+        compile_cache_dir=FLAGS.compile_cache_dir,
     )
     if rc:
         sys.exit(rc)

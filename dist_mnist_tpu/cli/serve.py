@@ -38,6 +38,12 @@ flags.DEFINE_float("max_wait_ms", 2.0, "coalesce window after first request")
 flags.DEFINE_integer("queue_depth", 256, "admission queue bound")
 flags.DEFINE_float("deadline_ms", 0, "per-request deadline; 0 = none")
 flags.DEFINE_boolean("prewarm", True, "compile all buckets before serving")
+flags.DEFINE_string("compile_cache_dir", None,
+                    "warm-start cache directory (compilecache/): prewarm "
+                    "deserializes the buckets a previous server process "
+                    "compiled (<dir>/exe) instead of recompiling, and JAX's "
+                    "persistent compilation cache runs under <dir>/xla; "
+                    "None = cold start")
 # -- load generation ---------------------------------------------------------
 flags.DEFINE_integer("requests", 512, "loadgen request count")
 flags.DEFINE_integer("concurrency", 64, "loadgen in-flight window")
@@ -83,10 +89,23 @@ def main(argv):
     bundle = load_for_serving(
         cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step
     )
+    store = None
+    if FLAGS.compile_cache_dir:
+        from pathlib import Path
+
+        from dist_mnist_tpu.compilecache import (
+            ExecutableStore,
+            enable_persistent_cache,
+        )
+
+        cache_root = Path(FLAGS.compile_cache_dir)
+        enable_persistent_cache(cache_root / "xla")
+        store = ExecutableStore(cache_root / "exe")
     engine = InferenceEngine(
         bundle.model, bundle.params, bundle.model_state, mesh,
         model_name=cfg.model, image_shape=bundle.image_shape,
         rules=bundle.rules, max_bucket=max(FLAGS.max_batch, 1),
+        store=store,
     )
     if FLAGS.fault_plan:
         from dist_mnist_tpu.faults import FaultPlan
@@ -114,6 +133,8 @@ def main(argv):
         )
     summary["checkpoint_step"] = bundle.step
     summary["restored"] = bundle.restored
+    if store is not None:
+        summary["compile_cache"] = store.stats()
     print(json.dumps(summary, indent=2, sort_keys=True))
 
 

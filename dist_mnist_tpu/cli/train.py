@@ -22,6 +22,11 @@ import time
 
 from absl import app, flags
 
+#: anchor for cold-start attribution (compilecache/startup.py): everything
+#: before main() runs — absl + this module's imports — lands in the
+#: ``import`` bucket; jax's import is deferred into ``init`` on purpose
+_MODULE_T0 = time.monotonic()
+
 log = logging.getLogger(__name__)
 
 FLAGS = flags.FLAGS
@@ -108,6 +113,15 @@ flags.DEFINE_string("fault_plan", None,
                     "deterministically at their configured steps; the same "
                     "plan drives launcher-level kills (cli/launch.py) and "
                     "in-process faults here")
+flags.DEFINE_string("compile_cache_dir", None,
+                    "warm-start cache directory (compilecache/): enables "
+                    "JAX's persistent compilation cache under <dir>/xla and "
+                    "an explicit serialized-AOT-executable store under "
+                    "<dir>/exe, so a restarted process loads its step "
+                    "programs in milliseconds instead of recompiling. "
+                    "cli/launch.py --max_restarts injects a shared dir "
+                    "automatically so generation N+1 warm-starts from "
+                    "generation N's work; None = cold every process")
 flags.DEFINE_integer("scan_chunk", 0,
                      "compile N steps into one lax.scan program (needs a "
                      "device input pipeline); hooks fire per chunk. The "
@@ -180,6 +194,8 @@ def _run_config(
     fault_plan=None,
     preemption=None,
     max_restore_fallbacks: int = 1,
+    compile_cache_dir: str | None = None,
+    startup=None,
 ):
     """Implementation behind `run_config` (the public wrapper adds the
     PRNG-impl scope — call THAT, not this).
@@ -209,7 +225,16 @@ def _run_config(
         make_train_step,
     )
 
+    from dist_mnist_tpu.compilecache import (
+        ExecutableStore,
+        StartupClock,
+        StartupHook,
+        cache_key,
+        enable_persistent_cache,
+    )
+
     t0 = time.monotonic()
+    startup = startup if startup is not None else StartupClock(t0=t0)
     # flag-combination errors fail BEFORE any expensive work (dataset load,
     # init, restore) — decidable from the arguments alone
     if scan_chunk and not input_pipeline.startswith("device"):
@@ -228,23 +253,56 @@ def _run_config(
             cfg.train_steps, scan_chunk, stop_at,
             stop_at - cfg.train_steps,
         )
-    mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
-    dataset = load_dataset(cfg.dataset, data_dir, seed=cfg.seed)
-    model = get_model(cfg.model, **cfg.model_kwargs)
-    optimizer = build_optimizer(cfg)
+    with startup.phase("init"):
+        mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+        dataset = load_dataset(cfg.dataset, data_dir, seed=cfg.seed)
+        model = get_model(cfg.model, **cfg.model_kwargs)
+        optimizer = build_optimizer(cfg)
     loss_fn = (
         losses.clipped_softmax_cross_entropy
         if cfg.loss == "clipped"
         else losses.softmax_cross_entropy
     )
 
+    # warm-start tiers (compilecache/): the XLA persistent cache catches
+    # every jit transparently; the ExecutableStore skips lowering too by
+    # deserializing whole AOT step programs under an explicit key
+    store = None
+    step_key = lambda kind: None  # noqa: E731 — keyed only when caching
+    if compile_cache_dir:
+        from pathlib import Path
+
+        cache_root = Path(compile_cache_dir)
+        enable_persistent_cache(cache_root / "xla")
+        store = ExecutableStore(cache_root / "exe")
+        key_fields = {
+            "config": cfg.name,
+            "model": cfg.model,
+            "model_kwargs": cfg.model_kwargs,
+            "batch_size": cfg.batch_size,
+            "optimizer": cfg.optimizer,
+            "loss": cfg.loss,
+            "remat": cfg.remat,
+            "remat_policy": cfg.remat_policy,
+            "augment": cfg.augment,
+            "mesh": tuple(sorted(mesh.shape.items())),
+            "sharding": cfg.sharding_rules,
+            "dtype": "float32",
+            "donate": True,
+            "scan_chunk": scan_chunk,
+            "input_pipeline": input_pipeline,
+            "prng": cfg.prng_impl,
+        }
+        step_key = lambda kind: cache_key({"kind": kind, **key_fields})  # noqa: E731
+
     rng = jax.random.PRNGKey(cfg.seed)
     sample = dataset.train_images[:1]
     # activate (not plain `with mesh:`) so mesh-adaptive attention
     # (ring/ulysses discover the seq axis via the ABSTRACT mesh) engages
     with activate(mesh):
-        state = create_train_state(model, optimizer, rng, sample)
-        state = shard_train_state(state, mesh, rules)
+        with startup.phase("init"):
+            state = create_train_state(model, optimizer, rng, sample)
+            state = shard_train_state(state, mesh, rules)
 
         manager = None
         restored = False
@@ -256,7 +314,8 @@ def _run_config(
                 # wrap BEFORE the startup restore so a corrupt fault
                 # targeting a pre-existing step fires on restore_or_init too
                 manager = fault_plan.wrap_checkpoint_manager(manager)
-            state, restored = manager.restore_or_init(state)
+            with startup.phase("restore"):
+                state, restored = manager.restore_or_init(state)
         log.info(
             "config %s: model=%s params on %d devices, restored=%s",
             cfg.name, cfg.model, jax.device_count(), restored,
@@ -282,20 +341,27 @@ def _run_config(
                     model, optimizer, mesh, dd, cfg.batch_size, scan_chunk,
                     loss_fn=loss_fn, rules=rules, remat=cfg.remat,
                     augment=cfg.augment, remat_policy=cfg.remat_policy,
+                    store=store, cache_key=step_key("scan"),
                 )
             else:
                 run = make_fused_train_step(
                     model, optimizer, mesh, dd, cfg.batch_size,
                     loss_fn=loss_fn, rules=rules, remat=cfg.remat,
                     augment=cfg.augment, remat_policy=cfg.remat_policy,
+                    store=store, cache_key=step_key("fused"),
                 )
             step_fn = lambda state, _batch: run(state)
+            # surface the wrapper's compile/load attribution through the
+            # adapter so the loop's goodput drain still sees it
+            step_fn.consume_compile_s = run.consume_compile_s
         else:
             step_fn = make_train_step(model, optimizer, mesh, loss_fn=loss_fn,
                                       rules=rules, remat=cfg.remat,
                                       augment=cfg.augment,
-                                      remat_policy=cfg.remat_policy)
-        eval_step = make_eval_step(model, mesh)
+                                      remat_policy=cfg.remat_policy,
+                                      store=store, cache_key=step_key("train"))
+        eval_step = make_eval_step(model, mesh, store=store,
+                                   cache_key=step_key("eval"))
         eval_fn = lambda s: evaluate(
             eval_step, s, dataset.test_images, dataset.test_labels, mesh
         )
@@ -316,6 +382,8 @@ def _run_config(
 
         goodput_hook = GoodputHook(writer, every_steps=cfg.log_every)
         hooks.append(goodput_hook)
+        startup_hook = StartupHook(writer, startup, store=store)
+        hooks.append(startup_hook)
         if fault_plan is not None:
             hooks.append(fault_plan.hook())
         eval_hook = None
@@ -388,6 +456,8 @@ def _run_config(
     return state, final, {"mesh": mesh, "model": model, "elapsed": elapsed,
                           "dataset": dataset, "loop": loop,
                           "goodput": goodput_hook.last,
+                          "startup": startup_hook.last,
+                          "compile_cache": store.stats() if store else None,
                           "preempted_at": loop.preempted_at}
 
 
@@ -458,6 +528,7 @@ def main(argv):
         )
 
     from dist_mnist_tpu.cluster import initialize_distributed
+    from dist_mnist_tpu.compilecache import StartupClock
     from dist_mnist_tpu.configs import get_config
     from dist_mnist_tpu.data import load_dataset
     from dist_mnist_tpu.faults import (
@@ -466,14 +537,21 @@ def main(argv):
         install_preemption_handlers,
     )
 
+    # cold-start attribution anchored at this module's import: everything
+    # up to here is the ``import`` bucket, the distributed/backend bring-up
+    # below is ``init``, and _run_config fills in the rest
+    clock = StartupClock(t0=_MODULE_T0)
+    clock.note("import", time.monotonic() - _MODULE_T0)
+
     # handshake installed BEFORE the expensive jax/distributed bring-up: a
     # SIGTERM that lands during init is honored at the first step boundary
     notice = PreemptionNotice()
     uninstall = install_preemption_handlers(notice)
-    initialize_distributed(
-        FLAGS.coordinator_address, FLAGS.num_processes, FLAGS.process_id,
-        platform=FLAGS.platform, host_device_count=FLAGS.host_device_count,
-    )
+    with clock.phase("init"):
+        initialize_distributed(
+            FLAGS.coordinator_address, FLAGS.num_processes, FLAGS.process_id,
+            platform=FLAGS.platform, host_device_count=FLAGS.host_device_count,
+        )
     cfg = _apply_flag_overrides(get_config(FLAGS.config))
     if FLAGS.download_only:
         ds = load_dataset(cfg.dataset, FLAGS.data_dir, seed=cfg.seed)
@@ -496,6 +574,8 @@ def main(argv):
             fault_plan=plan,
             preemption=notice,
             max_restore_fallbacks=FLAGS.max_restore_fallbacks,
+            compile_cache_dir=FLAGS.compile_cache_dir,
+            startup=clock,
         )
     finally:
         uninstall()

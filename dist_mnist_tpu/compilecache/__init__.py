@@ -1,0 +1,37 @@
+"""Warm-start engine: durable compiled programs + startup attribution.
+
+Two layers make compilation a once-per-program-change cost instead of a
+once-per-process cost (docs/PERF.md "Cold start & warm restarts"):
+
+- `enable_persistent_cache` turns on JAX's own persistent compilation
+  cache (`jax_compilation_cache_dir`) — XLA-level, transparent, shared by
+  every jit in the process.
+- `ExecutableStore` is the explicit tier above it: serialized AOT
+  executables (`jax.experimental.serialize_executable`) keyed by
+  `cache_key(...)` over everything that changes the compiled program
+  (model config, mesh shape, sharding strategy, dtype, donation, scan
+  chunk, jax/backend version). A warm process deserializes in
+  milliseconds instead of re-lowering + re-compiling; a corrupt entry is
+  quarantined to a recompile + overwrite, never a crash.
+
+`StartupClock`/`StartupHook` are the attribution side: process wall time
+bucketed into import/init/restore/compile/first-step, published as
+`startup/*` and `compile_cache/*` metrics so `bench.py --coldstart` and
+restart generations (`cli/launch.py --max_restarts`) can show exactly
+where cold-start time went and how much a warm start saved.
+"""
+
+from dist_mnist_tpu.compilecache.store import (
+    ExecutableStore,
+    cache_key,
+    enable_persistent_cache,
+)
+from dist_mnist_tpu.compilecache.startup import StartupClock, StartupHook
+
+__all__ = [
+    "ExecutableStore",
+    "StartupClock",
+    "StartupHook",
+    "cache_key",
+    "enable_persistent_cache",
+]

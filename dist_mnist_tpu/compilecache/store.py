@@ -1,0 +1,189 @@
+"""Serialized-AOT-executable store + the XLA persistent-cache switch.
+
+The store is deliberately dumb durable storage: one file per key, atomic
+replace on write, every read failure (missing, truncated, corrupt pickle,
+incompatible serialized executable) degrades to a MISS — the caller
+recompiles and overwrites. The interesting contract is the KEY: callers
+must fold in everything that changes the compiled program (see
+`cache_key`); jax/jaxlib/backend versions are folded in automatically so
+an upgraded runtime can never deserialize a stale binary.
+
+Entry format: pickle of ``{"exe": bytes, "in_tree": PyTreeDef,
+"out_tree": PyTreeDef, "meta": dict}`` — the three values
+`jax.experimental.serialize_executable.serialize` returns, plus
+provenance (compile wall ms, jax version) so a warm load can report how
+much compile time it saved (`compile_ms_saved`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import threading
+import time
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+#: suffix for store entries (one serialized executable each)
+ENTRY_SUFFIX = ".jaxexe"
+#: prefix of in-flight atomic-write temp files (conftest leak check)
+TMP_PREFIX = ".tmp-"
+#: temp files currently being written, for the test-suite leak check —
+#: a non-empty set after a test means some save path skipped its finally
+_PENDING_TMP: set = set()
+
+
+def cache_key(fields: dict) -> str:
+    """Stable hex key over `fields` + the runtime's own identity.
+
+    `fields` must contain everything that changes the compiled program:
+    model config, mesh shape, sharding strategy, dtype, donation, scan
+    chunk, batch geometry. The jax/jaxlib versions and active backend are
+    merged in automatically (a serialized executable is only valid on the
+    runtime that produced it); pass the same names explicitly to override
+    — tests use this to pin cross-version invalidation.
+    """
+    import jax
+    import jaxlib
+
+    full = {
+        "jax_version": jax.__version__,
+        "jaxlib_version": getattr(jaxlib.version, "__version__", "unknown"),
+        "backend": jax.default_backend(),
+        **fields,
+    }
+    blob = json.dumps(full, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def enable_persistent_cache(directory, *, min_compile_secs: float = 0.5) -> None:
+    """Point JAX's persistent compilation cache at `directory` (the
+    XLA-level warm-start tier — transparent to every jit in the process).
+    Best-effort: an older jax without the knobs just stays cold."""
+    try:
+        import jax
+
+        Path(directory).mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(directory))
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+        )
+    except Exception as e:  # noqa: BLE001 — warm-start aid, never fatal
+        log.warning("persistent compilation cache unavailable: %s", e)
+
+
+class ExecutableStore:
+    """key -> serialized AOT executable on disk, with hit/miss/corrupt
+    counters and load-vs-compile wall-time attribution.
+
+    Thread-safe; failure-soft on BOTH sides: `load` returns None on any
+    problem (the caller compiles fresh and `save` overwrites the bad
+    entry), `save` logs and returns 0 instead of raising — a full disk
+    must not kill a training run that was going to compile anyway."""
+
+    def __init__(self, directory):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._stats = {
+            "hits": 0,
+            "misses": 0,
+            "corrupt": 0,
+            "bytes_read": 0,
+            "bytes_written": 0,
+            "load_ms": 0.0,
+            "save_ms": 0.0,
+            # compile wall time the hits avoided, as recorded by whoever
+            # saved the entry (meta["compile_ms"]) — the warm-start win
+            "compile_ms_saved": 0.0,
+        }
+
+    def _path(self, key: str) -> Path:
+        return self.dir / f"{key}{ENTRY_SUFFIX}"
+
+    def load(self, key: str):
+        """The deserialized executable for `key`, or None on miss OR on any
+        corrupt/unreadable/incompatible entry (which is deleted so the
+        subsequent `save` starts clean)."""
+        from jax.experimental import serialize_executable
+
+        path = self._path(key)
+        t0 = time.perf_counter()
+        try:
+            blob = path.read_bytes()
+        except OSError:
+            with self._lock:
+                self._stats["misses"] += 1
+            return None
+        try:
+            entry = pickle.loads(blob)
+            exe = serialize_executable.deserialize_and_load(
+                entry["exe"], entry["in_tree"], entry["out_tree"]
+            )
+        except Exception as e:  # noqa: BLE001 — corrupt entry => recompile
+            log.warning(
+                "compile-cache entry %s unreadable (%s: %s); treating as a "
+                "miss and removing it", path.name, type(e).__name__, e,
+            )
+            try:
+                path.unlink()
+            except OSError:
+                pass
+            with self._lock:
+                self._stats["corrupt"] += 1
+                self._stats["misses"] += 1
+            return None
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            self._stats["hits"] += 1
+            self._stats["bytes_read"] += len(blob)
+            self._stats["load_ms"] += dt_ms
+            self._stats["compile_ms_saved"] += float(
+                entry.get("meta", {}).get("compile_ms", 0.0)
+            )
+        return exe
+
+    def save(self, key: str, compiled, meta: dict | None = None) -> int:
+        """Serialize `compiled` under `key` (atomic replace — a concurrent
+        reader sees the old entry or the new one, never a torn write).
+        Returns bytes written (0 on any failure)."""
+        from jax.experimental import serialize_executable
+
+        t0 = time.perf_counter()
+        tmp = self.dir / f"{TMP_PREFIX}{key}-{os.getpid()}"
+        try:
+            payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+            import jax
+
+            blob = pickle.dumps({
+                "exe": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+                "meta": {"jax_version": jax.__version__, **(meta or {})},
+            })
+            _PENDING_TMP.add(tmp)
+            try:
+                tmp.write_bytes(blob)
+                os.replace(tmp, self._path(key))
+            finally:
+                _PENDING_TMP.discard(tmp)
+                if tmp.exists():
+                    tmp.unlink()
+        except Exception as e:  # noqa: BLE001 — warm-start aid, never fatal
+            log.warning("compile-cache save %s failed (%s: %s); continuing "
+                        "uncached", key, type(e).__name__, e)
+            return 0
+        with self._lock:
+            self._stats["bytes_written"] += len(blob)
+            self._stats["save_ms"] += (time.perf_counter() - t0) * 1e3
+        return len(blob)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+        out["entries"] = len(list(self.dir.glob(f"*{ENTRY_SUFFIX}")))
+        return out

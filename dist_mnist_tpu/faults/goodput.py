@@ -16,6 +16,12 @@ supervised restarts) has a metric to move:
 - ``stall_s``      — blocked pulling the next batch or on the runahead
                      bound (the InputPipelineHook's feed/runahead clocks,
                      summed).
+- ``compile_s``    — synchronous XLA compile or executable-store load of
+                     a step program (the warm-start tier, compilecache/;
+                     reported by the step wrapper's `consume_compile_s`).
+                     A restart generation that warm-starts shows
+                     milliseconds here where a cold one shows seconds —
+                     the compile cost PR 4's supervisor made recurring.
 
 ``goodput_fraction = productive_s / total_wall_s`` — everything not in
 the productive bucket (including untracked overhead: hook bodies, eval,
@@ -45,6 +51,7 @@ class GoodputClock:
         self.replay_s = 0.0
         self.restore_s = 0.0
         self.stall_s = 0.0
+        self.compile_s = 0.0
         self.replayed_steps = 0
         #: one dict per recovery: failed_at_step, restored_step, restore_s,
         #: replay_s, replayed_steps, complete, latency_s (once known)
@@ -64,6 +71,9 @@ class GoodputClock:
 
     def add_productive(self, dt: float) -> None:
         self.productive_s += dt
+
+    def add_compile(self, dt: float) -> None:
+        self.compile_s += dt
 
     @property
     def in_replay(self) -> bool:
@@ -138,6 +148,7 @@ class GoodputClock:
             "replay_s": self.replay_s,
             "restore_s": self.restore_s,
             "stall_s": self.stall_s,
+            "compile_s": self.compile_s,
             "total_wall_s": self.total_wall_s(),
             "goodput_fraction": self.goodput_fraction(),
             "recoveries": len(self.events),

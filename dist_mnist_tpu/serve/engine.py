@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -38,32 +39,67 @@ log = logging.getLogger(__name__)
 
 
 class CompiledModelCache:
-    """key -> AOT-compiled executable, with hit/miss counters and compile
-    wall-time attribution. Keys are `(model_name, input_shape, mesh_key,
-    dtype)` — everything that changes the compiled program."""
+    """key -> AOT-compiled executable, with hit/miss counters, per-key
+    compile/load attribution, and an optional DISK tier. Keys are
+    `(model_name, input_shape, mesh_key, dtype)` — everything that changes
+    the compiled program.
 
-    def __init__(self):
+    With `store` (a compilecache.ExecutableStore), a memory miss consults
+    the store before compiling and saves after: a restarted server's
+    `prewarm()` deserializes last generation's executables in milliseconds
+    instead of recompiling every bucket. Hits are tiered — `hits_memory`
+    vs `hits_disk` — and `per_key` records, for each key, which tier
+    satisfied it first and the compile-or-load wall ms it cost."""
+
+    def __init__(self, store=None):
         self._lock = threading.Lock()
         self._cache: dict = {}
+        self._store = store
         self.hits = 0
         self.misses = 0
+        self.hits_memory = 0
+        self.hits_disk = 0
+        #: key -> {"tier": memory|disk|fresh, "compile_ms", "load_ms", "hits"}
+        self.per_key: dict = {}
         self.times: dict = {}  # stopclock accumulator: compile/execute secs
 
-    def get(self, key, build):
-        """The executable for `key`, compiling via `build()` on miss.
-        Compilation runs under the lock: concurrent misses for the same
-        bucket must not compile twice."""
+    def get(self, key, build, *, store_key: str | None = None):
+        """The executable for `key`: memory tier, then the disk store
+        (when wired and `store_key` given), then `build()`. Compilation
+        runs under the lock: concurrent misses for the same bucket must
+        not compile twice."""
         with self._lock:
-            hit = key in self._cache
-            if hit:
+            if key in self._cache:
                 self.hits += 1
+                self.hits_memory += 1
+                self.per_key[key]["hits"] += 1
                 return self._cache[key]
+            if self._store is not None and store_key is not None:
+                t0 = _time.perf_counter()
+                exe = self._store.load(store_key)
+                if exe is not None:
+                    load_ms = (_time.perf_counter() - t0) * 1e3
+                    self.hits += 1
+                    self.hits_disk += 1
+                    self.per_key[key] = {"tier": "disk", "compile_ms": 0.0,
+                                         "load_ms": load_ms, "hits": 1}
+                    self._cache[key] = exe
+                    log.info("loaded %s from compile cache (%.0f ms)",
+                             key, load_ms)
+                    return exe
             self.misses += 1
             with stopclock(self.times, "compile"):
+                t0 = _time.perf_counter()
                 exe = build()
+                compile_ms = (_time.perf_counter() - t0) * 1e3
+            self.per_key[key] = {"tier": "fresh", "compile_ms": compile_ms,
+                                 "load_ms": 0.0, "hits": 0}
             self._cache[key] = exe
+            if self._store is not None and store_key is not None:
+                self._store.save(store_key, exe,
+                                 meta={"compile_ms": compile_ms})
             log.info("compiled %s (miss #%d, %.0f ms)", key, self.misses,
-                     self.times["compile"] * 1e3 / self.times["compile_count"])
+                     compile_ms)
             return exe
 
     def stats(self) -> dict:
@@ -71,10 +107,13 @@ class CompiledModelCache:
             return {
                 "hits": self.hits,
                 "misses": self.misses,
+                "hits_memory": self.hits_memory,
+                "hits_disk": self.hits_disk,
                 "entries": len(self._cache),
                 "compile_secs": self.times.get("compile", 0.0),
                 "execute_secs": self.times.get("execute", 0.0),
                 "execute_count": self.times.get("execute_count", 0),
+                "per_key": {str(k): dict(v) for k, v in self.per_key.items()},
             }
 
 
@@ -103,12 +142,14 @@ class InferenceEngine:
         image_shape: tuple[int, ...],
         rules: ShardingRules = DP_RULES,
         max_bucket: int = 256,
+        store=None,
     ):
         self.model = model
         self.mesh = mesh
         self.model_name = model_name
         self.image_shape = tuple(image_shape)
-        self.cache = CompiledModelCache()
+        self.cache = CompiledModelCache(store=store)
+        self._rules = rules
         # buckets must divide over the data axis; the smallest power of two
         # >= the axis size always does (the axis size is itself a device
         # count, i.e. a power of two on every supported topology)
@@ -164,8 +205,27 @@ class InferenceEngine:
         )
         return jitted.lower(self.params, self.model_state, abstract_x).compile()
 
+    def _store_key(self, bucket: int) -> str:
+        """Durable-store key for a bucket's program — same contract as the
+        train side (compilecache.cache_key folds jax/backend versions in)."""
+        from dist_mnist_tpu.compilecache import cache_key
+
+        return cache_key({
+            "kind": "serve",
+            "model": self.model_name,
+            "input_shape": (bucket, *self.image_shape),
+            "mesh": tuple(sorted(self.mesh.shape.items())),
+            "dtype": "uint8->float32",
+            "rules": self._rules,
+        })
+
     def compiled_for(self, bucket: int):
-        return self.cache.get(self._key(bucket), lambda: self._compile(bucket))
+        # key the disk tier only when one is wired — predict() lands here
+        # per request and the hash need not be paid on the memory fast path
+        sk = (self._store_key(bucket)
+              if self.cache._store is not None else None)
+        return self.cache.get(self._key(bucket), lambda: self._compile(bucket),
+                              store_key=sk)
 
     def prewarm(self, buckets: list[int] | None = None) -> int:
         """Compile the expected buckets up front (all of them by default) so

@@ -196,9 +196,19 @@ class TrainLoop:
                     self._host_step += self.steps_per_call
                     if self.runahead:
                         self._inflight.append(outputs)
+                    # synchronous compile / executable-store load time the
+                    # wrapper just spent (train/step._lazy_jit) — charged to
+                    # the goodput compile bucket BEFORE after_step fires, so
+                    # StartupHook publishes a truthful compile attribution
+                    compile_s = 0.0
+                    consume = getattr(self.step_fn, "consume_compile_s", None)
+                    if consume is not None:
+                        compile_s = consume()
+                        if compile_s:
+                            g.add_compile(compile_s)
                     for h in self.hooks:
                         h.after_step(self._host_step, self.state, outputs)
-                    dt_step = time.monotonic() - t_step
+                    dt_step = max(0.0, time.monotonic() - t_step - compile_s)
                     if g.in_replay:
                         # catching back up to the pre-failure step: correct
                         # work, but no NEW progress — charged to replay, and

@@ -186,7 +186,8 @@ def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
     return one_step
 
 
-def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None):
+def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None,
+              store=None, key=None):
     """jit on first call, deriving state shardings from the live state.
 
     `bound_data`: resident arrays (e.g. a DeviceDataset's) passed as the
@@ -194,31 +195,81 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None):
     explicit arg, never a closed-over constant, because a multi-process
     global array may not be captured by a jit (it spans non-addressable
     devices). Callers of the returned wrapper then pass only `state`.
-    """
-    compiled: dict = {}
 
-    def _ensure_jit(state):
-        if "fn" not in compiled:
-            shd = tree_sharding(state, mesh, rules)
-            if bound_data is not None:
-                extra_shd = (tuple(a.sharding for a in bound_data),)
-            elif n_args == 2:
-                extra_shd = ({"image": batch_sharding(mesh),
-                              "label": batch_sharding(mesh)},)
-            else:
-                extra_shd = ()
-            compiled["fn"] = jax.jit(
-                step, in_shardings=(shd,) + extra_shd,
-                out_shardings=(shd, None),
-                donate_argnums=(0,) if donate else (),
-            )
+    `store` + `key` (compilecache/store.py) switch the first call to the
+    WARM-START path: AOT-compile (`lower(...).compile()`), trying the
+    executable store first — a prior process's serialized executable
+    deserializes in milliseconds where a cold compile costs seconds — and
+    saving after a fresh compile so the next process warm-starts. The
+    wrapper records the outcome in `wrapper.cache_stats` (tier
+    disk|fresh, load/compile ms) and surfaces the synchronous
+    compile-or-load seconds through `wrapper.consume_compile_s()` for the
+    loop's goodput/startup attribution. Without a store the jit stays
+    lazy and shape-polymorphic, exactly as before.
+    """
+    import time as _time
+
+    compiled: dict = {}
+    #: warm-start outcome of the first call; tier None until then
+    cache_stats: dict = {"tier": None, "compile_ms": 0.0, "load_ms": 0.0,
+                         "key": key}
+    _pending_compile_s = [0.0]
 
     def _args(rest):
         return (bound_data,) if bound_data is not None else rest
 
+    def _ensure_jit(state, rest=()):
+        if "fn" in compiled:
+            return
+        shd = tree_sharding(state, mesh, rules)
+        if bound_data is not None:
+            extra_shd = (tuple(a.sharding for a in bound_data),)
+        elif n_args == 2:
+            extra_shd = ({"image": batch_sharding(mesh),
+                          "label": batch_sharding(mesh)},)
+        else:
+            extra_shd = ()
+        jitted = jax.jit(
+            step, in_shardings=(shd,) + extra_shd,
+            out_shardings=(shd, None),
+            donate_argnums=(0,) if donate else (),
+        )
+        if store is None or key is None:
+            compiled["fn"] = jitted
+            return
+        t0 = _time.perf_counter()
+        exe = store.load(key)
+        if exe is not None:
+            dt = _time.perf_counter() - t0
+            compiled["fn"], compiled["aot"] = exe, True
+            cache_stats.update(tier="disk", load_ms=dt * 1e3)
+            _pending_compile_s[0] += dt
+            return
+        exe = jitted.lower(state, *_args(rest)).compile()
+        dt = _time.perf_counter() - t0
+        compiled["fn"], compiled["aot"] = exe, True
+        cache_stats.update(tier="fresh", compile_ms=dt * 1e3)
+        _pending_compile_s[0] += dt
+        store.save(key, exe, meta={"compile_ms": dt * 1e3})
+
+    def _aot_or_lowered(state, rest):
+        """A Compiled for the analysis helpers: the AOT executable when the
+        warm-start path built one, else lower+compile (hits XLA's cache
+        when the step has already run)."""
+        _ensure_jit(state, rest)
+        if compiled.get("aot"):
+            return compiled["fn"]
+        return compiled["fn"].lower(state, *_args(rest)).compile()
+
     def wrapper(state, *rest):
-        _ensure_jit(state)
+        _ensure_jit(state, rest)
         return compiled["fn"](state, *_args(rest))
+
+    def consume_compile_s() -> float:
+        """Synchronous compile-or-load seconds accumulated since the last
+        call — the loop drains this into the goodput `compile` bucket."""
+        s, _pending_compile_s[0] = _pending_compile_s[0], 0.0
+        return s
 
     def cost_analysis(state, *rest):
         """XLA's cost analysis (flops, bytes accessed) for ONE invocation —
@@ -228,11 +279,8 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None):
         already run. Pass any args with the right shapes/shardings (e.g.
         the step's own output state). None when the backend has no cost
         model."""
-        _ensure_jit(state)
         try:
-            ca = compiled["fn"].lower(
-                state, *_args(rest)
-            ).compile().cost_analysis()
+            ca = _aot_or_lowered(state, rest).cost_analysis()
         except Exception:  # noqa: BLE001 — metrics aid, never fail a run
             return None
         if isinstance(ca, (list, tuple)):  # older jax: one dict per device
@@ -245,11 +293,8 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None):
         attribution story (`bench.py --memory`). Same lower+compile-only
         contract as `cost_analysis`: never executes, safe before the first
         donated call, None when the backend doesn't report it."""
-        _ensure_jit(state)
         try:
-            return compiled["fn"].lower(
-                state, *_args(rest)
-            ).compile().memory_analysis()
+            return _aot_or_lowered(state, rest).memory_analysis()
         except Exception:  # noqa: BLE001 — metrics aid, never fail a run
             return None
 
@@ -258,17 +303,16 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None):
         assert WHICH collectives the partitioner inserted (e.g. fsdp must
         show an all-gather on param use; dp must not). None when the
         backend can't render it."""
-        _ensure_jit(state)
         try:
-            return compiled["fn"].lower(
-                state, *_args(rest)
-            ).compile().as_text()
+            return _aot_or_lowered(state, rest).as_text()
         except Exception:  # noqa: BLE001
             return None
 
     wrapper.cost_analysis = cost_analysis
     wrapper.memory_analysis = memory_analysis
     wrapper.compiled_text = compiled_text
+    wrapper.cache_stats = cache_stats
+    wrapper.consume_compile_s = consume_compile_s
     return wrapper
 
 
@@ -284,6 +328,8 @@ def make_train_step(
     remat: bool = False,
     augment: bool = False,
     remat_policy: str = "dots_no_batch",
+    store=None,
+    cache_key: str | None = None,
 ):
     """Build `step(state, batch) -> (state, metrics)` jitted over `mesh`.
 
@@ -292,6 +338,8 @@ def make_train_step(
       mutable PS variables, without the mutation).
     - batch["image"] is uint8 NHWC sharded on `data`; normalization to
       [0,1] f32 runs on-device post-shard (4x less host->device traffic).
+    - `store`/`cache_key` (compilecache/): warm-start from a serialized
+      AOT executable when a prior process saved one under this key.
     """
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
@@ -301,7 +349,8 @@ def make_train_step(
                            remat=remat, augment=augment,
                            remat_policy=remat_policy)
 
-    return _lazy_jit(step, mesh, rules, donate, n_args=2)
+    return _lazy_jit(step, mesh, rules, donate, n_args=2,
+                     store=store, key=cache_key)
 
 
 def make_fused_train_step(
@@ -316,6 +365,8 @@ def make_fused_train_step(
     remat: bool = False,
     augment: bool = False,
     remat_policy: str = "dots_no_batch",
+    store=None,
+    cache_key: str | None = None,
 ):
     """`step(state) -> (state, metrics)` with BATCH SAMPLING INSIDE the
     compiled program (data/pipeline.DeviceDataset): the host does zero
@@ -327,7 +378,8 @@ def make_fused_train_step(
                                batch_size, remat=remat, augment=augment,
                                remat_policy=remat_policy)
     return _lazy_jit(one_step, mesh, rules, donate=True,
-                     bound_data=device_dataset.arrays)
+                     bound_data=device_dataset.arrays,
+                     store=store, key=cache_key)
 
 
 def make_scanned_train_fn(
@@ -343,6 +395,8 @@ def make_scanned_train_fn(
     remat: bool = False,
     augment: bool = False,
     remat_policy: str = "dots_no_batch",
+    store=None,
+    cache_key: str | None = None,
 ):
     """`run(state) -> (state, metrics)` executing `chunk` fused steps in ONE
     XLA program via `lax.scan` — zero per-step Python dispatch, the
@@ -362,10 +416,11 @@ def make_scanned_train_fn(
         return state, jax.tree.map(jnp.mean, outs)
 
     return _lazy_jit(run_chunk, mesh, rules, donate=True,
-                     bound_data=device_dataset.arrays)
+                     bound_data=device_dataset.arrays,
+                     store=store, key=cache_key)
 
 
-def make_eval_step(model, mesh: Mesh):
+def make_eval_step(model, mesh: Mesh, *, store=None, cache_key: str | None = None):
     """`eval_step(state, batch) -> (sum_loss, correct_count, n)` — summable
     partial results so full-test-set eval streams in fixed-size batches.
 
@@ -374,7 +429,12 @@ def make_eval_step(model, mesh: Mesh):
     the mesh's `data` sharding. A bare `@jax.jit` here silently RESHARDED
     a TP/FSDP-sharded state to replicated for eval — an all-gather of
     params+slots per eval batch, defeating resident sharding exactly when
-    memory headroom matters."""
+    memory headroom matters.
+
+    `store`/`cache_key` (compilecache/): like the train step, the first
+    call AOT-compiles and round-trips the executable store so restarts
+    skip the eval compile too. Eval batches keep one shape (evaluate()
+    pads the tail), so pinning to the first call's shape loses nothing."""
 
     compiled: dict = {}
 
@@ -398,9 +458,21 @@ def make_eval_step(model, mesh: Mesh):
             batch_shd = {"image": batch_sharding(mesh),
                          "label": batch_sharding(mesh)}
             compiled["shardings"] = (state_shd, batch_shd)
-            compiled["fn"] = jax.jit(
+            jitted = jax.jit(
                 _eval_core, in_shardings=(state_shd, batch_shd)
             )
+            if store is not None and cache_key is not None:
+                exe = store.load(cache_key)
+                if exe is None:
+                    import time as _time
+
+                    t0 = _time.perf_counter()
+                    exe = jitted.lower(state, batch).compile()
+                    store.save(cache_key, exe, meta={
+                        "compile_ms": (_time.perf_counter() - t0) * 1e3})
+                compiled["fn"] = exe
+            else:
+                compiled["fn"] = jitted
         return compiled["fn"](state, batch)
 
     # For tests: the (state, batch) in_shardings captured at first call,

@@ -22,6 +22,11 @@ set -u
 OUT="${1:-/tmp/measure_all_$(date +%Y%m%d_%H%M%S)}"
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
+# one probe verdict per backend for the WHOLE battery: the first stage
+# probes for real, every later stage reads the cached verdict (bench.py
+# _probe) — on a down relay that turns N stages x retries x
+# BENCH_PROBE_TIMEOUT_S of waiting into a single timed-out probe
+export BENCH_PROBE_CACHE="$OUT/probe_cache.json"
 
 run_stage() { # name timeout_s cmd...
   local name="$1" budget="$2"; shift 2
@@ -56,6 +61,7 @@ run_stage bench_serve     900 python bench.py --serve --deadline 800
 run_stage bench_input     900 python bench.py --input --steps 200 --deadline 800
 run_stage bench_memory    900 python bench.py --memory --deadline 800
 run_stage bench_faults    900 python bench.py --faults --deadline 800
+run_stage bench_coldstart 900 python bench.py --coldstart --deadline 800
 run_stage step_ablation  1800 python scripts/step_ablation.py
 run_stage vit_probe      3600 python scripts/vit_probe.py
 run_stage perf_sweep     1800 python scripts/perf_sweep.py

@@ -14,6 +14,9 @@ mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 # one pattern for every harvest/display site (drift risk otherwise)
 METRIC_RE='"metric"\|"variant"\|"summary"'
+# shared probe verdict across the catch-up stages (see measure_all.sh);
+# NOT set during the probe loop below — each round must re-probe for real
+PROBE_CACHE="$OUT/probe_cache.json"
 
 run_stage() { # name timeout_s cmd...   (same shape as measure_all.sh)
   local name="$1" budget="$2"; shift 2
@@ -37,6 +40,10 @@ for i in $(seq 1 "$ROUNDS"); do
     # own delimited block instead of anonymous duplicate lines
     echo "{\"retry_pass\": \"$(date -u +%FT%TZ)\", \"outdir\": \"$OUT\"}" \
       >> docs/measurements/r5_retry.jsonl
+    # fresh verdict file per pass: the relay just answered, so stale
+    # down-verdicts from an earlier pass must not short-circuit this one
+    rm -f "$PROBE_CACHE"
+    export BENCH_PROBE_CACHE="$PROBE_CACHE"
     # first ViT-family stage pays the cold compile (docs/PERF.md ~25 min)
     run_stage bench_vit_tp    3200 python bench.py --config vit_tiny_cifar_tp --deadline 3000
     run_stage bench_vit_uly   1800 python bench.py --config vit_tiny_cifar_ulysses --deadline 1700
@@ -51,6 +58,7 @@ for i in $(seq 1 "$ROUNDS"); do
     run_stage bench_input     900 python bench.py --input --steps 200 --deadline 800
     run_stage bench_memory    900 python bench.py --memory --deadline 800
     run_stage bench_faults    900 python bench.py --faults --deadline 800
+    run_stage bench_coldstart 900 python bench.py --coldstart --deadline 800
     run_stage step_ablation   1800 python scripts/step_ablation.py
     run_stage vit_probe       3600 python scripts/vit_probe.py
     run_stage perf_sweep      1800 python scripts/perf_sweep.py

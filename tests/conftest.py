@@ -57,16 +57,26 @@ def _no_leaked_prefetch_workers():
     """Every background resource must be drained by test end: prefetch
     workers (a leak means some path — exception, early close, re-seek —
     skipped the stream drain), fault-injection timer threads (``Fault*``,
-    cli/launch.py's chaos kill), and supervisor child PROCESSES (a live
+    cli/launch.py's chaos kill), supervisor child PROCESSES (a live
     child after launch() returned would outlive the test and poison the
-    next one's port/coordinator). Polls briefly: a worker that JUST saw
-    its stop flag may still be mid-exit when the test returns."""
+    next one's port/coordinator), compile-cache atomic-write temp files
+    (compilecache/store.py `_PENDING_TMP` — a pending entry means a save
+    path skipped its finally), and warm-start/coldstart temp dirs created
+    OUTSIDE pytest's tmp root (launch()'s supervisor mkdtemp and
+    bench.py's coldstart pair dir must clean up after themselves). Polls
+    briefly: a worker that JUST saw its stop flag may still be mid-exit
+    when the test returns."""
     import sys
+    import tempfile
     import threading
     import time
+    from pathlib import Path
 
     from dist_mnist_tpu.data.prefetch import THREAD_NAME_PREFIX
 
+    tmp_root = Path(tempfile.gettempdir())
+    _stray_globs = ("dist_mnist_warmstart_*", "bench_coldstart_*")
+    before = {p for g in _stray_globs for p in tmp_root.glob(g)}
     yield
     deadline = time.monotonic() + 2.0
     leaked: list = ["unchecked"]
@@ -74,11 +84,18 @@ def _no_leaked_prefetch_workers():
         leaked = [t.name for t in threading.enumerate()
                   if t.is_alive()
                   and (t.name.startswith(THREAD_NAME_PREFIX)
-                       or t.name.startswith("Fault"))]
+                       or t.name.startswith("Fault")
+                       or t.name.startswith("CompileCache"))]
         launch_mod = sys.modules.get("dist_mnist_tpu.cli.launch")
         if launch_mod is not None:
             leaked += [f"child pid={p.pid}" for p in launch_mod._LIVE_CHILDREN
                        if p.poll() is None]
+        store_mod = sys.modules.get("dist_mnist_tpu.compilecache.store")
+        if store_mod is not None:
+            leaked += [f"pending cache tmp {p}"
+                       for p in store_mod._PENDING_TMP]
+        leaked += [f"stray tmp dir {p}" for g in _stray_globs
+                   for p in tmp_root.glob(g) if p not in before]
         if not leaked:
             return
         time.sleep(0.02)

@@ -1,0 +1,477 @@
+"""Warm-start engine tests (compilecache/): executable-store round trips,
+cache-key invalidation, corrupt-entry tolerance, startup/goodput compile
+attribution, supervisor cache-dir injection, the serve disk tier, and
+bench's probe-verdict cache."""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from dist_mnist_tpu import optim
+from dist_mnist_tpu.cluster.mesh import activate
+from dist_mnist_tpu.compilecache import (
+    ExecutableStore,
+    StartupClock,
+    StartupHook,
+    cache_key,
+)
+from dist_mnist_tpu.compilecache.store import ENTRY_SUFFIX
+from dist_mnist_tpu.data.pipeline import shard_batch
+from dist_mnist_tpu.models import get_model
+from dist_mnist_tpu.parallel.sharding import shard_train_state
+from dist_mnist_tpu.train import create_train_state, make_eval_step
+from dist_mnist_tpu.train.step import make_train_step
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+# -- cache_key ----------------------------------------------------------------
+
+BASE_FIELDS = {
+    "kind": "train", "model": "mlp", "batch_size": 64,
+    "mesh": (("data", 8),), "sharding": "dp", "dtype": "float32",
+    "donate": True, "scan_chunk": 0,
+}
+
+
+def test_cache_key_stable():
+    assert cache_key(dict(BASE_FIELDS)) == cache_key(dict(BASE_FIELDS))
+    assert len(cache_key(BASE_FIELDS)) == 32
+
+
+@pytest.mark.parametrize("change", [
+    {"mesh": (("data", 4), ("model", 2))},   # mesh shape
+    {"sharding": "fsdp"},                    # sharding strategy
+    {"dtype": "bfloat16"},                   # dtype
+    {"donate": False},                       # donation
+    {"scan_chunk": 100},                     # scan chunk
+    {"jax_version": "0.0.0-stale"},          # runtime version (implicit field)
+    {"backend": "tpu"},                      # backend (implicit field)
+])
+def test_cache_key_invalidates(change):
+    assert cache_key({**BASE_FIELDS, **change}) != cache_key(BASE_FIELDS)
+
+
+# -- ExecutableStore round trip ----------------------------------------------
+
+def _mlp_fixture(mesh, small_mnist, batch=64):
+    model = get_model("mlp")
+    opt = optim.adam(1e-3)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               small_mnist.train_images[:1])
+    state = shard_train_state(state, mesh)
+    batch_np = {"image": small_mnist.train_images[:batch],
+                "label": small_mnist.train_labels[:batch].astype(np.int32)}
+    return model, opt, state, shard_batch(batch_np, mesh)
+
+
+def _losses(step, state, batch, n=3):
+    out_losses = []
+    for _ in range(n):
+        state, out = step(state, batch)
+        out_losses.append(np.asarray(jax.device_get(out["loss"])).tobytes())
+    return out_losses
+
+
+def test_store_round_trip_bit_identical(mesh8, small_mnist, tmp_path):
+    """save -> load in a fresh wrapper (the new-process path) -> the loaded
+    executable produces a bit-identical trajectory to the compiling one."""
+    model, opt, state, batch = _mlp_fixture(mesh8, small_mnist)
+    key = cache_key(BASE_FIELDS)
+    with activate(mesh8):
+        store1 = ExecutableStore(tmp_path / "exe")
+        step1 = make_train_step(model, opt, mesh8, donate=False,
+                                store=store1, cache_key=key)
+        cold = _losses(step1, state, batch)
+        assert step1.cache_stats["tier"] == "fresh"
+        assert step1.cache_stats["compile_ms"] > 0
+        assert store1.stats() == {**store1.stats(), "misses": 1, "entries": 1}
+        # drained once by the caller; second drain must be zero
+        assert step1.consume_compile_s() > 0
+        assert step1.consume_compile_s() == 0.0
+
+        # fresh store object + fresh wrapper over the same directory — the
+        # same isolation a restarted process has
+        store2 = ExecutableStore(tmp_path / "exe")
+        step2 = make_train_step(model, opt, mesh8, donate=False,
+                                store=store2, cache_key=key)
+        warm = _losses(step2, state, batch)
+        assert step2.cache_stats["tier"] == "disk"
+        s2 = store2.stats()
+        assert (s2["hits"], s2["misses"], s2["corrupt"]) == (1, 0, 0)
+        assert s2["compile_ms_saved"] > 0
+    assert warm == cold
+
+
+def test_eval_step_round_trips_store(mesh8, small_mnist, tmp_path):
+    model, opt, state, batch = _mlp_fixture(mesh8, small_mnist)
+    key = cache_key({**BASE_FIELDS, "kind": "eval"})
+    with activate(mesh8):
+        store = ExecutableStore(tmp_path / "exe")
+        ev1 = make_eval_step(model, mesh8, store=store, cache_key=key)
+        r1 = jax.device_get(ev1(state, batch))
+        assert store.stats()["misses"] == 1
+
+        store2 = ExecutableStore(tmp_path / "exe")
+        ev2 = make_eval_step(model, mesh8, store=store2, cache_key=key)
+        r2 = jax.device_get(ev2(state, batch))
+        assert store2.stats()["hits"] == 1
+    assert all(np.asarray(a).tobytes() == np.asarray(b).tobytes()
+               for a, b in zip(r1, r2))
+
+
+def test_corrupt_entry_is_quarantined_and_overwritten(mesh8, small_mnist,
+                                                      tmp_path):
+    """Garbage entry -> miss + unlink (never a crash); the subsequent save
+    overwrites it and the NEXT load hits."""
+    model, opt, state, batch = _mlp_fixture(mesh8, small_mnist)
+    key = cache_key(BASE_FIELDS)
+    store = ExecutableStore(tmp_path / "exe")
+    entry = tmp_path / "exe" / f"{key}{ENTRY_SUFFIX}"
+    entry.write_bytes(b"not a pickled executable")
+    assert store.load(key) is None
+    assert not entry.exists()  # quarantined
+    s = store.stats()
+    assert (s["corrupt"], s["misses"], s["hits"]) == (1, 1, 0)
+
+    with activate(mesh8):
+        step = make_train_step(model, opt, mesh8, donate=False,
+                               store=store, cache_key=key)
+        _losses(step, state, batch, n=1)
+    assert step.cache_stats["tier"] == "fresh"
+    assert entry.exists()  # recompile overwrote the quarantined slot
+    assert ExecutableStore(tmp_path / "exe").load(key) is not None
+
+
+def test_truncated_entry_falls_back(mesh8, small_mnist, tmp_path):
+    model, opt, state, batch = _mlp_fixture(mesh8, small_mnist)
+    key = cache_key(BASE_FIELDS)
+    store = ExecutableStore(tmp_path / "exe")
+    with activate(mesh8):
+        step = make_train_step(model, opt, mesh8, donate=False,
+                               store=store, cache_key=key)
+        _losses(step, state, batch, n=1)
+    entry = tmp_path / "exe" / f"{key}{ENTRY_SUFFIX}"
+    blob = entry.read_bytes()
+    entry.write_bytes(blob[: len(blob) // 2])  # torn write / partial copy
+    store2 = ExecutableStore(tmp_path / "exe")
+    assert store2.load(key) is None
+    assert store2.stats()["corrupt"] == 1
+    assert not entry.exists()
+
+
+def test_save_is_failure_soft(tmp_path):
+    store = ExecutableStore(tmp_path / "exe")
+    # an unserializable object must log-and-return-0, never raise: a full
+    # disk or an odd executable must not kill a run that was going to
+    # compile anyway
+    assert store.save("somekey", object()) == 0
+    assert store.stats()["bytes_written"] == 0
+
+
+# -- startup clock / goodput attribution -------------------------------------
+
+def test_startup_clock_buckets_and_residual():
+    clock = StartupClock()
+    clock.note("import", 1.0)
+    with clock.phase("init"):
+        pass
+    clock.note("compile", 0.5)
+    assert clock.snapshot()["compile_ms"] == 500.0
+    assert "time_to_first_step_ms" not in clock.snapshot()  # not frozen yet
+    clock.first_step_done()
+    assert clock.time_to_first_step_s is not None
+    # pin the frozen headline so the residual arithmetic is deterministic
+    # (first_step_done is first-call-wins, so a direct set is the test's
+    # stand-in for "the first step landed 3s after t0")
+    clock.time_to_first_step_s = 3.0
+    init_s = clock.buckets["init"]
+    first = clock.snapshot()
+    assert first["time_to_first_step_ms"] == 3000.0
+    # residual: ttfs minus everything attributed, floored at zero
+    assert first["first_step_ms"] == pytest.approx(
+        max(0.0, (3.0 - 1.5 - init_s) * 1e3))
+    # compile noted AFTER the freeze shrinks the residual, not the headline
+    clock.note("compile", 10.0)
+    again = clock.snapshot()
+    assert again["time_to_first_step_ms"] == 3000.0
+    assert again["first_step_ms"] == 0.0
+    # freeze is first-call-wins
+    clock.first_step_done()
+    assert clock.time_to_first_step_s == 3.0
+
+
+def test_goodput_clock_compile_bucket():
+    from dist_mnist_tpu.faults.goodput import GoodputClock
+
+    g = GoodputClock()
+    g.add_compile(1.25)
+    g.add_compile(0.25)
+    assert g.snapshot()["compile_s"] == 1.5
+
+
+class _CaptureWriter:
+    def __init__(self):
+        self.scalar_calls: list = []
+
+    def scalars(self, d, step):
+        self.scalar_calls.append((dict(d), step))
+
+    def flush(self):
+        pass
+
+
+def test_loop_drains_compile_into_goodput_and_startup_hook_publishes(
+        mesh8, small_mnist, tmp_path):
+    """End to end through TrainLoop: the wrapper's compile time lands in
+    the goodput `compile` bucket BEFORE after_step hooks fire, and the
+    StartupHook publishes `startup/*` + `compile_cache/*` once."""
+    from dist_mnist_tpu import hooks as hooks_lib
+    from dist_mnist_tpu.train import TrainLoop
+
+    model, opt, state, batch = _mlp_fixture(mesh8, small_mnist)
+    store = ExecutableStore(tmp_path / "exe")
+    writer = _CaptureWriter()
+    clock = StartupClock()
+    hook = StartupHook(writer, clock, store=store)
+    with activate(mesh8):
+        step = make_train_step(model, opt, mesh8, donate=False,
+                               store=store, cache_key=cache_key(BASE_FIELDS))
+        loop = TrainLoop(step, state, iter([batch] * 4),
+                         [hooks_lib.StopAtStepHook(last_step=3), hook])
+        loop.run()
+    assert loop.goodput.compile_s > 0
+    assert loop.goodput.snapshot()["compile_s"] == loop.goodput.compile_s
+    # published exactly once, at the first step
+    assert len(writer.scalar_calls) == 1
+    tags, at_step = writer.scalar_calls[0]
+    assert at_step == 1
+    assert tags["startup/compile_ms"] == pytest.approx(
+        loop.goodput.compile_s * 1e3)
+    assert tags["startup/time_to_first_step_ms"] > 0
+    assert tags["compile_cache/misses"] == 1.0
+    assert tags["compile_cache/entries"] == 1.0
+    assert hook.last["cache_misses"] == 1
+
+
+# -- supervisor cache-dir injection (jax-free stub children) ------------------
+
+ARGV_STUB = textwrap.dedent("""\
+    import os, sys, time
+
+    args = dict(a.split("=", 1) for a in sys.argv[1:]
+                if a.startswith("--") and "=" in a)
+    with open(args["--argv_log"], "a") as fh:
+        fh.write(" ".join(sys.argv[1:]) + "\\n")
+    if int(args.get("--process_id", "0")) == 0:
+        time.sleep(0.5)
+        sys.exit(0)
+    marker = args.get("--marker")
+    if marker and not os.path.exists(marker):
+        open(marker, "w").close()
+        sys.exit(3)
+    sys.exit(0)
+""")
+
+
+@pytest.fixture()
+def argv_stub(tmp_path):
+    path = tmp_path / "argv_stub.py"
+    path.write_text(ARGV_STUB)
+    return [sys.executable, str(path)]
+
+
+def _cache_dirs_per_line(argv_log: Path) -> list[list[str]]:
+    return [[a.split("=", 1)[1] for a in line.split()
+             if a.startswith("--compile_cache_dir=")]
+            for line in argv_log.read_text().splitlines()]
+
+
+def test_supervisor_injects_shared_cache_dir_across_generations(
+        argv_stub, tmp_path):
+    """Every generation of a supervised cluster gets the SAME injected
+    --compile_cache_dir, and the supervisor-owned dir is removed when the
+    job ends."""
+    from dist_mnist_tpu.cli.launch import launch
+
+    argv_log = tmp_path / "argv.log"
+    rc = launch(
+        2,
+        [f"--argv_log={argv_log}", f"--marker={tmp_path / 'marker'}"],
+        child_command=argv_stub, max_restarts=2, restart_backoff_s=0.05,
+    )
+    assert rc == 0
+    per_line = _cache_dirs_per_line(argv_log)
+    assert len(per_line) == 4  # 2 processes x 2 generations
+    assert all(len(dirs) == 1 for dirs in per_line)  # injected exactly once
+    dirs = {d for line in per_line for d in line}
+    assert len(dirs) == 1  # one shared dir across ALL generations
+    injected = dirs.pop()
+    assert "dist_mnist_warmstart_" in injected
+    assert not Path(injected).exists()  # supervisor cleaned its own dir
+
+
+def test_supervisor_respects_explicit_cache_dir(argv_stub, tmp_path):
+    from dist_mnist_tpu.cli.launch import launch
+
+    argv_log = tmp_path / "argv.log"
+    explicit = tmp_path / "cc"
+    explicit.mkdir()
+    rc = launch(
+        2,
+        [f"--argv_log={argv_log}", f"--compile_cache_dir={explicit}"],
+        child_command=argv_stub, max_restarts=1, restart_backoff_s=0.05,
+    )
+    assert rc == 0
+    per_line = _cache_dirs_per_line(argv_log)
+    assert per_line and all(line == [str(explicit)] for line in per_line)
+    assert explicit.exists()  # an explicit dir is never deleted
+
+
+def test_unsupervised_launch_injects_nothing(argv_stub, tmp_path):
+    from dist_mnist_tpu.cli.launch import launch
+
+    argv_log = tmp_path / "argv.log"
+    rc = launch(2, [f"--argv_log={argv_log}"], child_command=argv_stub,
+                max_restarts=0)
+    assert rc == 0
+    assert all(not dirs for dirs in _cache_dirs_per_line(argv_log))
+
+
+# -- serve disk tier ----------------------------------------------------------
+
+def test_serve_cache_disk_tier_and_per_key_stats(mesh8, tmp_path):
+    from dist_mnist_tpu.serve import InferenceEngine, load_for_serving
+
+    bundle = load_for_serving("mlp_mnist", mesh8)
+    store = ExecutableStore(tmp_path / "exe")
+
+    def make_engine(st):
+        return InferenceEngine(
+            bundle.model, bundle.params, bundle.model_state, mesh8,
+            model_name="mlp-cc", image_shape=bundle.image_shape,
+            rules=bundle.rules, max_bucket=8, store=st,
+        )
+
+    e1 = make_engine(store)
+    e1.prewarm([8])
+    s1 = e1.cache.stats()
+    assert (s1["misses"], s1["hits_disk"], s1["hits_memory"]) == (1, 0, 0)
+    (pk1,) = s1["per_key"].values()
+    assert pk1["tier"] == "fresh" and pk1["compile_ms"] > 0
+
+    # memory tier on a repeat hit
+    e1.compiled_for(8)
+    s1b = e1.cache.stats()
+    assert (s1b["hits"], s1b["hits_memory"]) == (1, 1)
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(3, *bundle.image_shape), dtype=np.uint8)
+    ref = e1.predict(img)
+
+    # a "restarted server": fresh engine, fresh store object, same dir
+    e2 = make_engine(ExecutableStore(tmp_path / "exe"))
+    e2.prewarm([8])
+    s2 = e2.cache.stats()
+    assert (s2["misses"], s2["hits_disk"]) == (0, 1)
+    (pk2,) = s2["per_key"].values()
+    assert pk2["tier"] == "disk" and pk2["load_ms"] > 0
+    # existing stat keys are preserved for the metrics/server plumbing
+    for k in ("hits", "misses", "entries", "compile_secs", "execute_secs",
+              "execute_count"):
+        assert k in s2
+    # the deserialized executable computes the same program
+    np.testing.assert_array_equal(e2.predict(img), ref)
+
+
+def test_serve_cache_without_store_unchanged(mesh8):
+    """No store wired -> exact legacy behavior and stat values."""
+    from dist_mnist_tpu.serve.engine import CompiledModelCache
+
+    cache = CompiledModelCache()
+    built = []
+    cache.get("k", lambda: built.append(1) or "exe")
+    assert cache.get("k", lambda: built.append(1) or "exe2") == "exe"
+    assert len(built) == 1
+    s = cache.stats()
+    assert (s["hits"], s["misses"], s["entries"]) == (1, 1, 1)
+    assert (s["hits_memory"], s["hits_disk"]) == (1, 0)
+
+
+# -- bench probe-verdict cache ------------------------------------------------
+
+@pytest.fixture()
+def probe_cache(tmp_path, monkeypatch):
+    path = tmp_path / "probe_cache.json"
+    monkeypatch.setenv("BENCH_PROBE_CACHE", str(path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    return path
+
+
+def _forbid_subprocess(monkeypatch):
+    def boom(*a, **k):
+        raise AssertionError("probe subprocess ran despite cached verdict")
+    monkeypatch.setattr(bench.subprocess, "run", boom)
+
+
+def test_probe_cache_hit_up_verdict(probe_cache, monkeypatch):
+    probe_cache.write_text(json.dumps({"cpu": []}))
+    _forbid_subprocess(monkeypatch)
+    assert bench._probe(3, 150) == []
+
+
+def test_probe_cache_hit_down_verdict(probe_cache, monkeypatch):
+    probe_cache.write_text(json.dumps({"cpu": ["probe timed out after 5s"]}))
+    _forbid_subprocess(monkeypatch)
+    errs = bench._probe(3, 150)
+    assert len(errs) == 1
+    assert "probe timed out after 5s" in errs[0]
+    assert "cached verdict" in errs[0]  # labeled as replayed, not fresh
+
+
+def test_probe_cache_keyed_by_platform(probe_cache, monkeypatch):
+    # a verdict for the default (tpu) probe must not satisfy the cpu probe
+    probe_cache.write_text(json.dumps({"default": []}))
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(a)
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "1")
+    errs = bench._probe(1, 150)
+    assert calls and "timed out" in errs[0]
+    # ... and the real probe's verdict was recorded under the cpu key
+    verdicts = json.loads(probe_cache.read_text())
+    assert verdicts["default"] == []
+    assert "timed out" in verdicts["cpu"][0]
+    # second probe replays the cached failure without a subprocess
+    _forbid_subprocess(monkeypatch)
+    assert "cached verdict" in bench._probe(1, 150)[-1]
+
+
+def test_probe_cache_unset_probes_normally(monkeypatch, tmp_path):
+    monkeypatch.delenv("BENCH_PROBE_CACHE", raising=False)
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(a)
+
+        class Out:
+            returncode = 0
+            stdout = "DEVCOUNT 8"
+            stderr = ""
+        return Out()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench._probe(1, 150) == []
+    assert len(calls) == 1
