@@ -518,6 +518,10 @@ def bench_serve(n_requests: int, concurrency: int) -> int:
         summary = run_loadgen(server, n_requests=n_requests,
                               concurrency=concurrency,
                               image_shape=bundle.image_shape, seed=0)
+    # the streaming-histogram layer's view of the same run (obs/hist.py —
+    # what /metrics exposes live): bounded-error percentiles next to the
+    # loadgen's exact ones, as a cross-check on the exposition path
+    hist_pcts = server.metrics.latency_percentiles()
     emit({
         "metric": metric,
         "value": round(summary["p99_ms"], 2),
@@ -526,7 +530,10 @@ def bench_serve(n_requests: int, concurrency: int) -> int:
         "extra": {
             "chips": jax.device_count(),
             "p50_ms": round(summary["p50_ms"], 2),
+            "p95_ms": round(summary["p95_ms"], 2),
             "mean_ms": round(summary["mean_ms"], 2),
+            "hist_latency_ms": {k: round(v, 2)
+                                for k, v in hist_pcts.items()},
             "n_requests": n_requests,
             "concurrency": concurrency,
             "ok": summary["ok"],
@@ -653,11 +660,21 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
     The recovered run's loss trajectory is ASSERTED bit-identical to the
     clean run's, step for step (the loop re-seeks the input stream on
     restore — replay, not skip): a resilience mechanism that perturbs the
-    math would be worse than the fault it hides."""
+    math would be worse than the fault it hides.
+
+    The FAULT run is additionally instrumented with a run journal
+    (obs/events.py) while the clean run stays obs-disabled — so the
+    trajectory assert above doubles as proof that observability is free:
+    the instrumented trajectory is bit-identical to an uninstrumented one.
+    The journal is cross-checked against the loop's own accounting
+    (restore events == goodput recoveries) and the step-time distribution
+    (obs/hist.py, the /metrics histogram layer) rides along in extra."""
     import tempfile
 
     import jax
     import numpy as np
+
+    from dist_mnist_tpu.obs import events as events_mod
 
     from dist_mnist_tpu import hooks as hooks_lib, optim
     from dist_mnist_tpu.checkpoint import CheckpointManager
@@ -724,9 +741,9 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
             loop.run()
             if manager:
                 manager.close()
-            return traj.loss, loop.goodput
+            return traj.loss, loop
 
-        clean_loss, _ = run()
+        clean_loss, _ = run()  # obs-disabled: no journal installed
         plan = FaultPlan([
             Fault.preempt(preempt_at),
             # target the checkpoint the restore will want (the save at the
@@ -734,16 +751,35 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
             Fault.corrupt_checkpoint(preempt_at),
         ])
         with tempfile.TemporaryDirectory(prefix="bench_faults_") as ckpt_dir:
-            fault_loss, goodput = run(plan=plan, ckpt_dir=ckpt_dir)
+            journal_path = os.path.join(ckpt_dir, "journal.jsonl")
+            prev = events_mod.set_journal(events_mod.RunJournal(journal_path))
+            try:
+                fault_loss, fault_loop = run(plan=plan, ckpt_dir=ckpt_dir)
+            finally:
+                j = events_mod.set_journal(prev)
+                if j is not None:
+                    j.close()
+            journal = events_mod.read_journal(journal_path)
+        goodput = fault_loop.goodput
 
     identical = (set(clean_loss) == set(fault_loss) and all(
         clean_loss[s].tobytes() == fault_loss[s].tobytes()
         for s in clean_loss))
     assert identical, (
-        "recovered loss trajectory diverged from the fault-free run")
+        "recovered loss trajectory diverged from the fault-free run "
+        "(the fault run was the journal-instrumented one: observability "
+        "must not perturb the math)")
     assert all(f.fired for f in plan.faults), (
         f"planned faults did not all fire: {plan.to_json()}")
     snap = goodput.snapshot()
+    # journal cross-check: the lifecycle record must agree with the loop's
+    # own goodput accounting, restart for restart
+    journal_restores = sum(1 for r in journal if r.get("event") == "restore")
+    journal_events = [r.get("event") for r in journal]
+    assert journal_restores == snap["recoveries"], (
+        f"journal restore events ({journal_restores}) != goodput "
+        f"recoveries ({snap['recoveries']}); journal saw: {journal_events}")
+    step_pcts = fault_loop.step_time_hist.percentiles()
     emit({
         "metric": metric,
         "value": round(snap["recovery_latency_ms"], 2),
@@ -766,6 +802,11 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
             "total_wall_s": round(snap["total_wall_s"], 3),
             "trajectory_identical": identical,
             "faults_fired": [f.kind for f in plan.fired()],
+            # fault-run step-time distribution (obs/hist.py — the same
+            # histogram /metrics exposes live)
+            "step_time_ms": {k: round(v, 3) for k, v in step_pcts.items()},
+            "journal_events": journal_events,
+            "journal_restores": journal_restores,
             **_anchor_fields(metric, snap["recovery_latency_ms"]),
         },
     })

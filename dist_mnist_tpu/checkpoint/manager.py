@@ -17,6 +17,8 @@ from pathlib import Path
 
 import jax
 
+from dist_mnist_tpu.obs import events
+
 log = logging.getLogger(__name__)
 
 try:
@@ -248,6 +250,7 @@ class CheckpointManager:
         if saved:
             self._last_saved = step
             log.info("checkpoint saved at step %d -> %s", step, self.directory)
+            events.emit("checkpoint_save", step=step)
         return bool(saved)
 
     def restore(self, target_state):
@@ -306,6 +309,7 @@ class CheckpointManager:
                 step, target_state, err
             )
         log.info("restored checkpoint step %d from %s", step, self.directory)
+        events.emit("checkpoint_restore", step=step)
         return restored
 
     def _step_before(self, step: int) -> int | None:
@@ -331,6 +335,7 @@ class CheckpointManager:
         if self._last_saved == step:
             self._last_saved = None  # a re-save of this step must not dedupe
         self._mgr.reload()
+        events.emit("checkpoint_quarantine", step=step)
 
     def _restore_with_structure_healing(self, step, target_state, err):
         """Fallback ladder for known benign structure drifts, tried in

@@ -150,6 +150,8 @@ def _launch_once(
     env_extra: dict[str, str] | None = None,
     kill_spec: tuple[int, float] | None = None,
     child_command: list[str] | None = None,
+    journal=None,
+    generation: int = 0,
 ) -> tuple[int, str | None, int | None]:
     """Spawn ONE cluster generation and wait it out.
 
@@ -211,6 +213,10 @@ def _launch_once(
                 if victim.poll() is None:
                     _say(f"[launcher] fault injected: SIGKILL p{k} "
                          f"after {delay:.1f}s")
+                    if journal is not None:
+                        journal.emit("fault_injected", kind="kill_process",
+                                     process=k, delay_s=delay,
+                                     gen=generation)
                     victim.kill()
 
             killer = threading.Thread(
@@ -305,6 +311,7 @@ def launch(
     kill_spec: tuple[int, float] | None = None,
     child_command: list[str] | None = None,
     compile_cache_dir: str | None = None,
+    journal: str | None = None,
 ) -> int:
     """Spawn the cluster; return 0 or a deterministic nonzero exit status
     (the first abnormal death's, signal deaths normalized to 128+N).
@@ -326,7 +333,20 @@ def launch(
     instead of paying the cold compile again — the recurring compile cost
     the restart loop would otherwise multiply. If the caller didn't pick a
     directory, the supervisor creates a private one and removes it when
-    the job ends; an explicit dir (flag or train_args) is left alone."""
+    the job ends; an explicit dir (flag or train_args) is left alone.
+
+    A supervised cluster also gets ONE RUN JOURNAL (obs/events.py): the
+    supervisor opens it, records its own lifecycle (``supervisor_start``,
+    per-generation ``generation_start``/``generation_end``,
+    ``supervisor_restart``, ``supervisor_stop``, launcher-level
+    ``fault_injected`` kills), and injects the path plus the generation
+    number into every child's environment (``DIST_MNIST_TPU_JOURNAL`` /
+    ``DIST_MNIST_TPU_GENERATION``) — so a fault-plan run leaves a single
+    machine-readable record of the whole restart sequence. An explicit
+    ``journal`` path survives the run; otherwise the journal lives inside
+    the supervisor-owned warm-start dir and is removed with it."""
+    from dist_mnist_tpu.obs import events as events_mod
+
     cache_dir_owned = False
     if max_restarts > 0 and compile_cache_dir is None and not any(
         a.startswith("--compile_cache_dir") for a in train_args
@@ -339,33 +359,62 @@ def launch(
         a.startswith("--compile_cache_dir") for a in train_args
     ):
         train_args = [*train_args, f"--compile_cache_dir={compile_cache_dir}"]
+    if journal is None and max_restarts > 0 and compile_cache_dir is not None:
+        journal = str(Path(compile_cache_dir) / "journal.jsonl")
+    jrnl = events_mod.RunJournal(journal) if journal else None
+    if jrnl is not None:
+        _say(f"[supervisor] run journal: {journal}")
+        jrnl.emit("supervisor_start", num_processes=num_processes,
+                  max_restarts=max_restarts)
     rng = random.Random(0)  # deterministic jitter (tests time the backoff)
     attempt = 0
+
+    def _stop(rc: int) -> int:
+        if jrnl is not None:
+            jrnl.emit("supervisor_stop", rc=rc, restarts=attempt)
+        return rc
+
     try:
         while True:
+            env_gen = dict(env_extra or {})
+            if journal:
+                env_gen[events_mod.ENV_JOURNAL] = journal
+                env_gen[events_mod.ENV_GENERATION] = str(attempt)
+            if jrnl is not None:
+                jrnl.emit("generation_start", gen=attempt)
             rc, failure, first_dead = _launch_once(
                 num_processes, train_args, port=port, platform=platform,
-                devices_per_process=devices_per_process, env_extra=env_extra,
+                devices_per_process=devices_per_process,
+                env_extra=env_gen or None,
                 kill_spec=kill_spec if attempt == 0 else None,
                 child_command=child_command,
+                journal=jrnl, generation=attempt,
             )
+            if jrnl is not None:
+                jrnl.emit("generation_end", gen=attempt, rc=rc,
+                          failure=failure, first_dead=first_dead)
             if rc == 0 or failure is None or max_restarts <= 0:
-                return rc
+                return _stop(rc)
             if first_dead == 0:
                 _say(f"[supervisor] chief died ({failure}); fatal — "
                      f"not restarting, rc={rc}")
-                return rc
+                return _stop(rc)
             if attempt >= max_restarts:
                 _say(f"[supervisor] {failure}; giving up after {attempt} "
                      f"restart(s), rc={rc}")
-                return rc
+                return _stop(rc)
             delay = (restart_backoff_s * (2 ** attempt)
                      * (1.0 + 0.5 * rng.random()))
             attempt += 1
             _say(f"[supervisor] {failure}; restarting cluster "
                  f"(attempt {attempt}/{max_restarts}) in {delay:.2f}s")
+            if jrnl is not None:
+                jrnl.emit("supervisor_restart", attempt=attempt,
+                          delay_s=round(delay, 3), failure=failure)
             time.sleep(delay)
     finally:
+        if jrnl is not None:
+            jrnl.close()
         if cache_dir_owned:
             import shutil
 
@@ -420,6 +469,7 @@ def main(argv):
         restart_backoff_s=FLAGS.restart_backoff_s,
         kill_spec=kill_spec,
         compile_cache_dir=FLAGS.compile_cache_dir,
+        journal=FLAGS.journal,
     )
     if rc:
         sys.exit(rc)

@@ -53,6 +53,14 @@ flags.DEFINE_string("fault_plan", None,
                     "serve_error faults wrap the engine so the batcher's "
                     "fail-one-batch-keep-serving isolation is drivable from "
                     "the CLI (docs/RESILIENCE.md)")
+flags.DEFINE_integer("metrics_port", 0,
+                     "serve /metrics (Prometheus text, incl. live latency/"
+                     "batch histograms), /healthz (serving -> draining) and "
+                     "/events on this port (obs/exporter.py); 0 = disabled")
+flags.DEFINE_string("journal", None,
+                    "append-only JSONL run-journal path (obs/events.py); "
+                    "defaults to $DIST_MNIST_TPU_JOURNAL, else "
+                    "<logdir>/events.jsonl when --logdir is set")
 
 
 def main(argv):
@@ -63,9 +71,18 @@ def main(argv):
     )
     logging.getLogger("absl").setLevel(logging.WARNING)
 
+    import os
+
     from dist_mnist_tpu.cluster import initialize_distributed
     from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
     from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.obs import (
+        HealthState,
+        MetricRegistry,
+        MetricsExporter,
+        RunJournal,
+    )
+    from dist_mnist_tpu.obs import events as events_mod
     from dist_mnist_tpu.obs.writers import make_default_writer
     from dist_mnist_tpu.serve import (
         InferenceEngine,
@@ -74,6 +91,26 @@ def main(argv):
         load_for_serving,
         run_loadgen,
     )
+
+    registry = MetricRegistry()
+    health = HealthState(
+        generation=int(os.environ.get(events_mod.ENV_GENERATION, "0")))
+    journal_path = (FLAGS.journal or os.environ.get(events_mod.ENV_JOURNAL)
+                    or (FLAGS.logdir and f"{FLAGS.logdir}/events.jsonl"))
+    journal = (RunJournal(journal_path, generation=health.generation)
+               if journal_path else None)
+    if journal is not None:
+        events_mod.set_journal(journal)
+    exporter = None
+    if FLAGS.metrics_port:
+        try:
+            exporter = MetricsExporter(
+                registry, health=health, journal_path=journal_path,
+                port=FLAGS.metrics_port,
+            ).start()
+        except OSError as e:
+            log.warning("metrics exporter: could not bind port %d (%s); "
+                        "continuing without exposition", FLAGS.metrics_port, e)
 
     initialize_distributed(
         None, 1, 0,
@@ -111,7 +148,7 @@ def main(argv):
         from dist_mnist_tpu.faults import FaultPlan
 
         engine = FaultPlan.from_spec(FLAGS.fault_plan).wrap_engine(engine)
-    writer = make_default_writer(FLAGS.logdir)
+    writer = make_default_writer(FLAGS.logdir, registry=registry)
     server = InferenceServer(
         engine,
         ServeConfig(
@@ -122,15 +159,25 @@ def main(argv):
             prewarm=FLAGS.prewarm,
         ),
         writer=writer,
+        health=health,
     )
-    with server:
-        summary = run_loadgen(
-            server,
-            n_requests=FLAGS.requests,
-            concurrency=FLAGS.concurrency,
-            image_shape=bundle.image_shape,
-            seed=FLAGS.seed,
-        )
+    # live full-distribution exposition of the serve ladders (/metrics)
+    server.metrics.attach_to(registry)
+    try:
+        with server:
+            summary = run_loadgen(
+                server,
+                n_requests=FLAGS.requests,
+                concurrency=FLAGS.concurrency,
+                image_shape=bundle.image_shape,
+                seed=FLAGS.seed,
+            )
+    finally:
+        if exporter is not None:
+            exporter.close()
+        if journal is not None:
+            events_mod.set_journal(None)
+            journal.close()
     summary["checkpoint_step"] = bundle.step
     summary["restored"] = bundle.restored
     if store is not None:

@@ -127,6 +127,19 @@ flags.DEFINE_integer("scan_chunk", 0,
                      "device input pipeline); hooks fire per chunk. The "
                      "bench-grade zero-dispatch path; 0 = one program per "
                      "step")
+flags.DEFINE_integer("metrics_port", 0,
+                     "serve /metrics (Prometheus text), /healthz (process "
+                     "state machine) and /events (journal tail) on this "
+                     "port from a background thread (obs/exporter.py). "
+                     "Multi-process: each process binds port + process_id. "
+                     "0 = disabled")
+flags.DEFINE_string("journal", None,
+                    "append-only JSONL run-journal path (obs/events.py) "
+                    "recording run/preemption/restore/checkpoint/fault/"
+                    "compile-cache lifecycle events. Defaults to "
+                    "$DIST_MNIST_TPU_JOURNAL (the supervisor injects a "
+                    "shared journal across restart generations), else "
+                    "<logdir>/events.jsonl when --logdir is set")
 
 
 def build_optimizer(cfg):
@@ -196,13 +209,118 @@ def _run_config(
     max_restore_fallbacks: int = 1,
     compile_cache_dir: str | None = None,
     startup=None,
+    metrics_port: int = 0,
+    journal=None,
+    generation: int = 0,
 ):
     """Implementation behind `run_config` (the public wrapper adds the
     PRNG-impl scope — call THAT, not this).
 
+    Sets up the observability spine around the run — metric registry,
+    /metrics + /healthz exporter (`metrics_port`), and run journal
+    (`journal` accepts a path or an obs.RunJournal; defaults to
+    <logdir>/events.jsonl) — then delegates to `_run_train`.
+
     Returns (final_state, final_eval_dict, context) where context carries
-    the mesh/model/etc. for callers that keep going.
+    the mesh/model/registry/health/etc. for callers that keep going.
     """
+    from pathlib import Path
+
+    from dist_mnist_tpu.obs import (
+        HealthState,
+        MetricRegistry,
+        MetricsExporter,
+        RunJournal,
+    )
+    from dist_mnist_tpu.obs import events as events_mod
+
+    registry = MetricRegistry()
+    health = HealthState(generation=generation)
+    journal_obj, journal_owned = None, False
+    if isinstance(journal, RunJournal):
+        journal_obj = journal
+    elif journal:
+        journal_obj, journal_owned = (
+            RunJournal(journal, generation=generation), True)
+    elif logdir:
+        journal_obj, journal_owned = (
+            RunJournal(Path(logdir) / "events.jsonl",
+                       generation=generation), True)
+    prev_journal = (events_mod.set_journal(journal_obj)
+                    if journal_obj is not None else None)
+    exporter = None
+    if metrics_port:
+        try:
+            exporter = MetricsExporter(
+                registry, health=health,
+                journal_path=journal_obj.path if journal_obj else None,
+                port=metrics_port,
+            ).start()
+        except OSError as e:
+            # exposition is an aid; a taken port must not kill training
+            log.warning("metrics exporter: could not bind port %d (%s); "
+                        "continuing without exposition", metrics_port, e)
+    events_mod.emit("run_start", config=cfg.name,
+                    train_steps=cfg.train_steps)
+    try:
+        state, final, ctx = _run_train(
+            cfg, data_dir=data_dir, checkpoint_dir=checkpoint_dir,
+            logdir=logdir, profile=profile, max_recoveries=max_recoveries,
+            extra_hooks=extra_hooks, mesh=mesh,
+            input_pipeline=input_pipeline, scan_chunk=scan_chunk,
+            prefetch_depth=prefetch_depth, runahead=runahead,
+            fault_plan=fault_plan, preemption=preemption,
+            max_restore_fallbacks=max_restore_fallbacks,
+            compile_cache_dir=compile_cache_dir, startup=startup,
+            registry=registry, health=health,
+        )
+        events_mod.emit("run_stop", ok=True, step=state.step_int,
+                        preempted_at=ctx.get("preempted_at"),
+                        reason=ctx["loop"].stop.reason)
+        ctx.update(
+            registry=registry, health=health,
+            journal=journal_obj.path if journal_obj else None,
+            metrics_url=exporter.url() if exporter else None,
+        )
+        return state, final, ctx
+    except BaseException as exc:
+        events_mod.emit("run_stop", ok=False, error=type(exc).__name__)
+        if health.state != "preempted":
+            health.set("failed", type(exc).__name__)
+        raise
+    finally:
+        if exporter is not None:
+            exporter.close()
+        if journal_obj is not None:
+            events_mod.set_journal(prev_journal)
+            if journal_owned:
+                journal_obj.close()
+
+
+def _run_train(
+    cfg,
+    *,
+    data_dir: str = "/tmp/mnist-data",
+    checkpoint_dir: str | None = None,
+    logdir: str | None = None,
+    profile: bool = False,
+    max_recoveries: int = 0,
+    extra_hooks=(),
+    mesh=None,
+    input_pipeline: str = "python",
+    scan_chunk: int = 0,
+    prefetch_depth: int = 0,
+    runahead: int = 0,
+    fault_plan=None,
+    preemption=None,
+    max_restore_fallbacks: int = 1,
+    compile_cache_dir: str | None = None,
+    startup=None,
+    registry=None,
+    health=None,
+):
+    """The training run itself (see `_run_config`, which wraps it in the
+    observability scope and owns the exporter/journal lifecycles)."""
     import jax
 
     from dist_mnist_tpu import hooks as hooks_lib
@@ -366,13 +484,15 @@ def _run_config(
             eval_step, s, dataset.test_images, dataset.test_labels, mesh
         )
 
-        writer = make_default_writer(logdir, chief=is_chief())
+        writer = make_default_writer(logdir, chief=is_chief(),
+                                     registry=registry)
         hooks = [
             hooks_lib.StopAtStepHook(last_step=cfg.train_steps),
             hooks_lib.StepCounterHook(
                 every_steps=cfg.log_every, batch_size=cfg.batch_size, writer=writer
             ),
             hooks_lib.InputPipelineHook(writer, every_steps=cfg.log_every),
+            hooks_lib.StepTimeHook(writer, every_steps=cfg.log_every),
             hooks_lib.LoggingHook(every_steps=cfg.log_every),
             hooks_lib.SummaryHook(writer, every_steps=cfg.log_every),
             hooks_lib.MemoryHook(writer, every_steps=cfg.log_every),
@@ -440,7 +560,12 @@ def _run_config(
             steps_per_call=max(1, scan_chunk),
             runahead=runahead,
             preemption=preemption,
+            health=health,
         )
+        if registry is not None:
+            # live full-distribution exposition of per-step wall time
+            registry.attach_histogram("train/step_time_ms",
+                                      loop.step_time_hist)
         state = loop.run()
         # EvalHook.end already evaluated the final state; don't pay for a
         # second full test-set pass
@@ -559,6 +684,18 @@ def main(argv):
                  ds.name, len(ds.train_labels), len(ds.test_labels), ds.synthetic)
         return
     plan = FaultPlan.from_spec(FLAGS.fault_plan) if FLAGS.fault_plan else None
+    import os
+
+    from dist_mnist_tpu.obs import events as events_mod
+
+    # journal precedence: explicit flag > supervisor-injected env (one
+    # journal shared across restart generations) > <logdir>/events.jsonl
+    journal = FLAGS.journal or os.environ.get(events_mod.ENV_JOURNAL)
+    generation = int(os.environ.get(events_mod.ENV_GENERATION, "0"))
+    # one exporter per process: offset by process_id so a multi-process
+    # host doesn't race for one port
+    metrics_port = (FLAGS.metrics_port + FLAGS.process_id
+                    if FLAGS.metrics_port else 0)
     try:
         _state, _final, ctx = run_config(
             cfg,
@@ -576,6 +713,9 @@ def main(argv):
             max_restore_fallbacks=FLAGS.max_restore_fallbacks,
             compile_cache_dir=FLAGS.compile_cache_dir,
             startup=clock,
+            metrics_port=metrics_port,
+            journal=journal,
+            generation=generation,
         )
     finally:
         uninstall()

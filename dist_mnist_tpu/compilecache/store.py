@@ -26,6 +26,8 @@ import threading
 import time
 from pathlib import Path
 
+from dist_mnist_tpu.obs import events
+
 log = logging.getLogger(__name__)
 
 #: suffix for store entries (one serialized executable each)
@@ -118,6 +120,7 @@ class ExecutableStore:
         except OSError:
             with self._lock:
                 self._stats["misses"] += 1
+            events.emit("compile_cache", outcome="miss", key=key)
             return None
         try:
             entry = pickle.loads(blob)
@@ -136,6 +139,7 @@ class ExecutableStore:
             with self._lock:
                 self._stats["corrupt"] += 1
                 self._stats["misses"] += 1
+            events.emit("compile_cache", outcome="corrupt", key=key)
             return None
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
@@ -145,6 +149,8 @@ class ExecutableStore:
             self._stats["compile_ms_saved"] += float(
                 entry.get("meta", {}).get("compile_ms", 0.0)
             )
+        events.emit("compile_cache", outcome="hit", key=key,
+                    load_ms=round(dt_ms, 3))
         return exe
 
     def save(self, key: str, compiled, meta: dict | None = None) -> int:
@@ -180,6 +186,8 @@ class ExecutableStore:
         with self._lock:
             self._stats["bytes_written"] += len(blob)
             self._stats["save_ms"] += (time.perf_counter() - t0) * 1e3
+        events.emit("compile_cache", outcome="save", key=key,
+                    bytes=len(blob))
         return len(blob)
 
     def stats(self) -> dict:

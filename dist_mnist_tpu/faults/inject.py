@@ -30,6 +30,7 @@ import time
 from pathlib import Path
 
 from dist_mnist_tpu.faults.plan import FaultPlan
+from dist_mnist_tpu.obs import events
 from dist_mnist_tpu.train.loop import PreemptionError
 
 log = logging.getLogger(__name__)
@@ -56,6 +57,7 @@ class FaultInjectionHook:
             if f.step is not None and step >= f.step:
                 f.fired = True
                 log.warning("fault injected: preemption at step %d", step)
+                events.emit("fault_injected", kind="preempt", step=step)
                 raise PreemptionError(f"injected preemption at step {step}")
 
     def after_step(self, step: int, state, outputs) -> None:
@@ -95,6 +97,8 @@ class FaultyBatches:
                             "fault injected: input stall %.2fs at step %d",
                             f.seconds or 0.0, step,
                         )
+                        events.emit("fault_injected", kind="stall_input",
+                                    step=step, seconds=f.seconds or 0.0)
                         time.sleep(f.seconds or 0.0)
                 try:
                     batch = next(it)
@@ -157,6 +161,8 @@ class FaultyCheckpointManager:
                 "fault injected: %s checkpoint step %d (%s)",
                 f.mode, f.step, damaged,
             )
+            events.emit("fault_injected", kind="corrupt_checkpoint",
+                        step=f.step, mode=f.mode)
         return self._inner.restore(target_state)
 
     def __getattr__(self, name):
@@ -185,6 +191,7 @@ class FaultyEngine:
                     "fault injected: serve engine error on predict call %d",
                     call,
                 )
+                events.emit("fault_injected", kind="serve_error", call=call)
                 raise RuntimeError(
                     f"injected serve engine error on predict call {call}"
                 )

@@ -11,6 +11,7 @@ from dist_mnist_tpu.hooks.builtin import (
     StopAtStepHook,
     StepCounterHook,
     InputPipelineHook,
+    StepTimeHook,
     LoggingHook,
     NaNGuardHook,
     NanLossError,
@@ -29,6 +30,7 @@ __all__ = [
     "StopAtStepHook",
     "StepCounterHook",
     "InputPipelineHook",
+    "StepTimeHook",
     "LoggingHook",
     "NaNGuardHook",
     "NanLossError",

@@ -148,6 +148,58 @@ class InputPipelineHook(Hook):
                     self._writer.scalar(k, v, step)
 
 
+class StepTimeHook(Hook):
+    """Per-step wall-time percentiles from the loop's streaming histogram
+    (train/loop.py `step_time_hist`, obs/hist.py). Publishes at a cadence
+    so p50/p95/p99 land in the same sinks (and live registry) as every
+    other scalar:
+
+      step_time/p50_ms  step_time/p95_ms  step_time/p99_ms
+      step_time/mean_ms
+
+    The histogram itself can also be attached to a MetricRegistry for
+    full-distribution /metrics exposition; this hook is the scalar-sink
+    (CSV/TB) view of the same ladder."""
+
+    def __init__(self, writer=None, every_steps: int = 100):
+        self._writer = writer
+        self._timer = EverySteps(every_steps=every_steps)
+        self.last: dict[str, float] = {}
+
+    def begin(self, loop):
+        self._loop = loop
+        self._timer.prime(loop.initial_step)
+
+    def _emit(self, step):
+        snap = self._loop.step_time_hist.snapshot()
+        if not snap["count"]:
+            return
+        vals = {
+            "step_time/p50_ms": snap["p50"],
+            "step_time/p95_ms": snap["p95"],
+            "step_time/p99_ms": snap["p99"],
+            "step_time/mean_ms": snap["mean"],
+        }
+        self.last = vals
+        if self._writer is not None:
+            batch_write = getattr(self._writer, "scalars", None)
+            if callable(batch_write):
+                batch_write(vals, step)
+            else:
+                for k, v in vals.items():
+                    self._writer.scalar(k, v, step)
+
+    def after_step(self, step, state, outputs):
+        if not self._timer.should_trigger(step):
+            return
+        self._timer.mark()
+        self._emit(step)
+
+    def end(self, state):
+        # final-distribution summary even for runs shorter than the cadence
+        self._emit(getattr(self._loop, "_host_step", 0))
+
+
 class LoggingHook(Hook):
     """≙ LoggingTensorHook (:169): periodic metric prints. Syncs device
     scalars only at its cadence."""

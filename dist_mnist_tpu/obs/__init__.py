@@ -1,5 +1,8 @@
 """Observability: metric writers (tf.summary / SummaryWriterCache analogue,
-SURVEY.md §5.5) and chrome-trace export (client/timeline.py analogue, §5.1)."""
+SURVEY.md §5.5), chrome-trace export (client/timeline.py analogue, §5.1),
+and the live telemetry spine — streaming histograms, in-process metric
+registry, /metrics + /healthz exposition, and the structured run journal
+(docs/OBSERVABILITY.md)."""
 
 from dist_mnist_tpu.obs.writers import (
     MetricWriter,
@@ -14,6 +17,10 @@ from dist_mnist_tpu.obs.timeline import (
     export_chrome_trace,
     summarize_trace,
 )
+from dist_mnist_tpu.obs.hist import StreamingHistogram
+from dist_mnist_tpu.obs.registry import MetricRegistry, RegistryWriter
+from dist_mnist_tpu.obs.exporter import HealthState, MetricsExporter
+from dist_mnist_tpu.obs.events import RunJournal
 
 __all__ = [
     "MetricWriter",
@@ -25,4 +32,10 @@ __all__ = [
     "latest_trace",
     "export_chrome_trace",
     "summarize_trace",
+    "StreamingHistogram",
+    "MetricRegistry",
+    "RegistryWriter",
+    "HealthState",
+    "MetricsExporter",
+    "RunJournal",
 ]

@@ -66,26 +66,48 @@ class CsvWriter:
     A CSV is a scalar sink, so histograms land as summary-stat rows
     (`tag/mean`, `tag/std`, ...)."""
 
+    # rows buffered past this count are flushed to disk: the window lost
+    # at abnormal exit is bounded, which is exactly when post-mortem
+    # metrics matter (docs/RESILIENCE.md)
+    FLUSH_EVERY = 32
+
     def __init__(self, path: str | Path):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = open(self.path, "a", newline="")
         self._writer = csv.writer(self._fh)
+        self._unflushed = 0
         if self._fh.tell() == 0:
             self._writer.writerow(["step", "tag", "value"])
 
+    def _wrote(self, n: int) -> None:
+        self._unflushed += n
+        if self._unflushed >= self.FLUSH_EVERY:
+            self.flush()
+
     def scalar(self, tag, value, step):
         self._writer.writerow([step, tag, value])
+        self._wrote(1)
 
     def scalars(self, values, step):
         self._writer.writerows([step, k, v] for k, v in values.items())
+        self._wrote(len(values))
 
     def histogram(self, tag, values, step):
-        for k, v in _summary_stats(values).items():
+        stats = _summary_stats(values)
+        for k, v in stats.items():
             self._writer.writerow([step, f"{tag}/{k}", v])
+        self._wrote(len(stats))
 
     def flush(self):
-        self._fh.flush()
+        if not self._fh.closed:
+            self._fh.flush()
+        self._unflushed = 0
+
+    def close(self):
+        if not self._fh.closed:
+            self._fh.flush()
+            self._fh.close()
 
 
 class TensorBoardWriter:
@@ -142,18 +164,42 @@ class MultiWriter:
 
     def histogram(self, tag, values, step):
         for w in self.writers:
-            w.histogram(tag, values, step)
+            # scalar-only writers degrade to summary-stat rows instead of
+            # crashing the whole fan-out (same contract as scalars above)
+            hist_write = getattr(w, "histogram", None)
+            if callable(hist_write):
+                hist_write(tag, values, step)
+            else:
+                for k, v in _summary_stats(values).items():
+                    w.scalar(f"{tag}/{k}", v, step)
 
     def flush(self):
         for w in self.writers:
             w.flush()
 
+    def close(self):
+        for w in self.writers:
+            close = getattr(w, "close", None)
+            if callable(close):
+                close()
+            else:
+                w.flush()
 
-def make_default_writer(logdir: str | Path | None, *, chief: bool = True):
-    """Stdout always (chief only); CSV + TensorBoard when a logdir is given."""
+
+def make_default_writer(logdir: str | Path | None, *, chief: bool = True,
+                        registry=None):
+    """Stdout always (chief only); CSV + TensorBoard when a logdir is given.
+    When a ``MetricRegistry`` is passed, a ``RegistryWriter`` joins the
+    fan-out on EVERY process (chief or not) so the local ``/metrics``
+    endpoint stays live even where the disk sinks are chief-gated."""
+    live: list[MetricWriter] = []
+    if registry is not None:
+        from dist_mnist_tpu.obs.registry import RegistryWriter
+
+        live.append(RegistryWriter(registry))
     if not chief:
-        return MultiWriter()
-    writers: list[MetricWriter] = [StdoutWriter()]
+        return MultiWriter(*live)
+    writers: list[MetricWriter] = live + [StdoutWriter()]
     if logdir is not None:
         writers.append(CsvWriter(Path(logdir) / "metrics.csv"))
         writers.append(TensorBoardWriter(logdir))

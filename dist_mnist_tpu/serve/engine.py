@@ -32,6 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+from dist_mnist_tpu.obs import events
 from dist_mnist_tpu.parallel.sharding import DP_RULES, ShardingRules, tree_sharding
 from dist_mnist_tpu.utils.timing import stopclock
 
@@ -100,6 +101,10 @@ class CompiledModelCache:
                                  meta={"compile_ms": compile_ms})
             log.info("compiled %s (miss #%d, %.0f ms)", key, self.misses,
                      compile_ms)
+            # the disk tier journals its own hits/misses (compilecache/
+            # store.py); a fresh compile is the remaining interesting case
+            events.emit("compile_cache", outcome="compile", key=str(key),
+                        compile_ms=round(compile_ms, 3))
             return exe
 
     def stats(self) -> dict:

@@ -99,6 +99,7 @@ def run_loadgen(
         "deadline_expired": deadline_expired,
         "errors": errors,
         "p50_ms": float(np.percentile(lat, 50)) if lat.size else float("nan"),
+        "p95_ms": float(np.percentile(lat, 95)) if lat.size else float("nan"),
         "p99_ms": float(np.percentile(lat, 99)) if lat.size else float("nan"),
         "mean_ms": float(lat.mean()) if lat.size else float("nan"),
     }

@@ -5,28 +5,28 @@ Host-side and lock-guarded (the batcher thread and every client thread
 record concurrently); nothing here touches a device. Emission goes through
 the existing `obs.writers.MetricWriter` protocol so serve metrics land in
 the same CSV/TensorBoard sinks as training metrics.
+
+Percentiles come from `obs.hist.StreamingHistogram` ladders instead of
+the old sample reservoirs: O(buckets) memory forever, mergeable across
+replicas, and attachable to a `MetricRegistry` so a live `/metrics`
+scrape sees the same distribution the final snapshot reports.
 """
 
 from __future__ import annotations
 
-import collections
+import math
 import threading
 
-import numpy as np
-
-# bounded reservoirs: a long-lived server must not grow memory with request
-# count. 65536 most-recent samples bounds the p99 estimate error well below
-# anything a BENCH round can resolve.
-_RESERVOIR = 65536
+from dist_mnist_tpu.obs.hist import StreamingHistogram
 
 
 class ServeMetrics:
     """Thread-safe accumulator for one server's lifetime.
 
-    Counters:  admitted, completed, rejected_queue_full, rejected_deadline,
-               rejected_shutdown, failed.
-    Reservoirs: request latency (ms, submit->result), executed batch sizes
-               (real rows), bucket occupancy (real rows / padded bucket).
+    Counters:   admitted, completed, rejected_queue_full, rejected_deadline,
+                rejected_shutdown, failed.
+    Histograms: request latency (ms, submit->result), executed batch sizes
+                (real rows), bucket occupancy (real rows / padded bucket).
     """
 
     def __init__(self):
@@ -37,9 +37,18 @@ class ServeMetrics:
         self.rejected_deadline = 0
         self.rejected_shutdown = 0
         self.failed = 0
-        self._latency_ms = collections.deque(maxlen=_RESERVOIR)
-        self._batch_sizes = collections.deque(maxlen=_RESERVOIR)
-        self._occupancy = collections.deque(maxlen=_RESERVOIR)
+        # own ladders per signal: latency spans µs..minutes; batch size is
+        # small integers; occupancy lives in (0, 1]
+        self.latency_ms = StreamingHistogram()
+        self.batch_size = StreamingHistogram()
+        self.batch_occupancy = StreamingHistogram()
+
+    def attach_to(self, registry) -> None:
+        """Expose the live ladders on a MetricRegistry (-> /metrics)."""
+        registry.attach_histogram("serve/latency_ms", self.latency_ms)
+        registry.attach_histogram("serve/batch_size", self.batch_size)
+        registry.attach_histogram("serve/batch_occupancy",
+                                  self.batch_occupancy)
 
     def record_admitted(self):
         with self._lock:
@@ -62,33 +71,28 @@ class ServeMetrics:
 
     def record_batch(self, n_real: int, bucket: int):
         """One executed batch: `n_real` genuine requests padded to `bucket`."""
-        with self._lock:
-            self._batch_sizes.append(n_real)
-            self._occupancy.append(n_real / bucket)
+        self.batch_size.observe(n_real)
+        self.batch_occupancy.observe(n_real / bucket)
 
     def record_latency(self, ms: float, n: int = 1):
+        self.latency_ms.observe(ms)
         with self._lock:
-            self._latency_ms.append(ms)
             self.completed += n
 
     def latency_percentiles(self) -> dict[str, float]:
-        with self._lock:
-            lat = np.asarray(self._latency_ms, dtype=np.float64)
-        if lat.size == 0:
-            return {"p50_ms": float("nan"), "p99_ms": float("nan"),
-                    "mean_ms": float("nan")}
-        return {
-            "p50_ms": float(np.percentile(lat, 50)),
-            "p99_ms": float(np.percentile(lat, 99)),
-            "mean_ms": float(lat.mean()),
-        }
+        s = self.latency_ms.snapshot()
+        if not s["count"]:
+            return {"p50_ms": float("nan"), "p95_ms": float("nan"),
+                    "p99_ms": float("nan"), "mean_ms": float("nan")}
+        return {"p50_ms": s["p50"], "p95_ms": s["p95"], "p99_ms": s["p99"],
+                "mean_ms": s["mean"]}
 
     def snapshot(self) -> dict:
         """Point-in-time summary (plain floats/ints — JSON-safe for bench)."""
         pct = self.latency_percentiles()
+        sizes = self.batch_size.snapshot()
+        occ = self.batch_occupancy.snapshot()
         with self._lock:
-            sizes = np.asarray(self._batch_sizes, dtype=np.float64)
-            occ = np.asarray(self._occupancy, dtype=np.float64)
             out = {
                 "admitted": self.admitted,
                 "completed": self.completed,
@@ -96,36 +100,45 @@ class ServeMetrics:
                 "rejected_deadline": self.rejected_deadline,
                 "rejected_shutdown": self.rejected_shutdown,
                 "failed": self.failed,
-                "n_batches": int(sizes.size),
+                "n_batches": int(sizes["count"]),
             }
         out.update(pct)
-        out["mean_batch_size"] = float(sizes.mean()) if sizes.size else 0.0
-        out["mean_occupancy"] = float(occ.mean()) if occ.size else 0.0
+        out["mean_batch_size"] = sizes["mean"] if sizes["count"] else 0.0
+        out["mean_occupancy"] = occ["mean"] if occ["count"] else 0.0
         return out
 
     def emit(self, writer, step: int, *, queue_depth: int | None = None,
              cache: dict | None = None) -> None:
         """Write the snapshot through an obs MetricWriter. `serve/` prefix
-        keeps the tags clear of training scalars in a shared logdir."""
+        keeps the tags clear of training scalars in a shared logdir. All
+        scalars go out as ONE batched `scalars()` call (the hook
+        convention — one writer call per cadence, not ~12)."""
         snap = self.snapshot()
-        for tag in ("p50_ms", "p99_ms", "mean_ms"):
+        vals: dict[str, float] = {}
+        for tag in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
             v = snap[tag]
-            if v == v:  # skip NaN (no completed requests yet)
-                writer.scalar(f"serve/latency_{tag}", v, step)
+            if not math.isnan(v):
+                vals[f"serve/latency_{tag}"] = v
         for tag in ("admitted", "completed", "rejected_queue_full",
                     "rejected_deadline", "rejected_shutdown", "failed"):
-            writer.scalar(f"serve/{tag}", snap[tag], step)
-        writer.scalar("serve/mean_batch_size", snap["mean_batch_size"], step)
-        writer.scalar("serve/mean_occupancy", snap["mean_occupancy"], step)
+            vals[f"serve/{tag}"] = snap[tag]
+        vals["serve/mean_batch_size"] = snap["mean_batch_size"]
+        vals["serve/mean_occupancy"] = snap["mean_occupancy"]
         if queue_depth is not None:
-            writer.scalar("serve/queue_depth", queue_depth, step)
+            vals["serve/queue_depth"] = queue_depth
         if cache:
-            writer.scalar("serve/cache_hits", cache.get("hits", 0), step)
-            writer.scalar("serve/cache_misses", cache.get("misses", 0), step)
-        with self._lock:
-            sizes = list(self._batch_sizes)
-            occ = list(self._occupancy)
-        if sizes:
-            writer.histogram("serve/batch_size", sizes, step)
-            writer.histogram("serve/batch_occupancy", occ, step)
+            vals["serve/cache_hits"] = cache.get("hits", 0)
+            vals["serve/cache_misses"] = cache.get("misses", 0)
+        batch_write = getattr(writer, "scalars", None)
+        if callable(batch_write):
+            batch_write(vals, step)
+        else:
+            for k, v in vals.items():
+                writer.scalar(k, v, step)
+        if self.batch_size.count:
+            writer.histogram("serve/batch_size",
+                             self.batch_size.representative_values(), step)
+            writer.histogram("serve/batch_occupancy",
+                             self.batch_occupancy.representative_values(),
+                             step)
         writer.flush()

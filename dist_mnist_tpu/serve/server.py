@@ -39,11 +39,15 @@ class ServeConfig:
 
 class InferenceServer:
     def __init__(self, engine: InferenceEngine, config: ServeConfig | None = None,
-                 *, writer=None):
+                 *, writer=None, health=None):
         self.engine = engine
         self.config = config or ServeConfig()
         self.metrics = ServeMetrics()
         self.writer = writer
+        # live /healthz state machine (obs/exporter.HealthState or None):
+        # serving after start(), draining during close() — so a router can
+        # stop sending to this replica before it disappears
+        self.health = health
         self._admission = AdmissionQueue(self.config.queue_depth, self.metrics)
         self._batcher = DynamicBatcher(
             engine, self._admission, self.metrics,
@@ -65,12 +69,22 @@ class InferenceServer:
             log.info("prewarmed %d bucket(s): %s", n, self.engine.buckets())
         self._batcher.start()
         self._started = True
+        if self.health is not None:
+            self.health.set("serving")
+        from dist_mnist_tpu.obs import events
+
+        events.emit("serve_start", prewarm=self.config.prewarm,
+                    max_batch=self.config.max_batch)
         return self
 
     def close(self, *, timeout: float = 30.0) -> bool:
         """Reject-new, finish-old; idempotent. Returns drain success."""
         if self._closed:
             return True
+        from dist_mnist_tpu.obs import events
+
+        if self.health is not None:
+            self.health.set("draining")
         self._admission.close()
         ok = self._batcher.drain(timeout=timeout) if self._started else True
         if not ok:
@@ -78,6 +92,10 @@ class InferenceServer:
         self._closed = True
         if self.writer is not None:
             self.emit_metrics(self.writer)
+        if self.health is not None:
+            self.health.set("stopped", "drained" if ok else "drain timeout")
+        events.emit("serve_stop", drained=ok,
+                    completed=self.metrics.completed)
         return ok
 
     def __enter__(self):

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import sys
 import time
 from typing import Iterable, Sequence
 
@@ -25,6 +26,8 @@ import jax
 
 from dist_mnist_tpu.faults.goodput import GoodputClock
 from dist_mnist_tpu.hooks.base import Hook
+from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.obs.hist import StreamingHistogram
 from dist_mnist_tpu.train.state import TrainState
 
 log = logging.getLogger(__name__)
@@ -95,6 +98,7 @@ class TrainLoop:
         steps_per_call: int = 1,
         runahead: int = 0,
         preemption=None,
+        health=None,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -111,6 +115,13 @@ class TrainLoop:
         # goodput attribution (faults/goodput.py): every second of run()'s
         # wall clock lands in a productive/restore/replay/stall bucket.
         self.goodput = GoodputClock()
+        # live /healthz state machine (obs/exporter.HealthState or None):
+        # training while the loop runs, preempted on a consumed notice,
+        # stopped/failed on exit.
+        self.health = health
+        # per-step wall time in ms, scrape-able live via the registry and
+        # summarized by StepTimeHook / bench.py --faults
+        self.step_time_hist = StreamingHistogram()
         # >1 when step_fn executes a compiled CHUNK of steps (lax.scan —
         # train/step.make_scanned_train_fn): hooks fire once per chunk at
         # the post-chunk step number; cadences/stops round up to the chunk.
@@ -150,6 +161,13 @@ class TrainLoop:
             getattr(self.preemption, "reason", None), step,
             "saved" if self.checkpoint_manager is not None else "skipped",
         )
+        events.emit(
+            "preemption", step=step,
+            reason=getattr(self.preemption, "reason", None),
+            checkpoint_saved=self.checkpoint_manager is not None,
+        )
+        if self.health is not None:
+            self.health.set("preempted", f"step={step}")
         self.request_stop(f"preempted@step={step}")
 
     def run(self) -> TrainState:
@@ -159,6 +177,8 @@ class TrainLoop:
         it = iter(self.batches)
         g = self.goodput
         g.start()
+        if self.health is not None:
+            self.health.set("training")
         try:
             while not self.stop.should_stop():
                 # preemption handshake: consumed only at step boundaries,
@@ -209,6 +229,9 @@ class TrainLoop:
                     for h in self.hooks:
                         h.after_step(self._host_step, self.state, outputs)
                     dt_step = max(0.0, time.monotonic() - t_step - compile_s)
+                    # per-STEP wall time even when step_fn runs a chunk
+                    self.step_time_hist.observe(
+                        dt_step * 1e3 / self.steps_per_call)
                     if g.in_replay:
                         # catching back up to the pre-failure step: correct
                         # work, but no NEW progress — charged to replay, and
@@ -249,12 +272,24 @@ class TrainLoop:
                             it.close()  # drain a prefetch worker promptly
                         self.batches = self.batches.at_step(self._host_step)
                         it = iter(self.batches)
+                    restore_s = time.monotonic() - t_restore
                     g.begin_recovery(
                         failed_at_step=failed_at,
                         restored_step=self._host_step,
-                        restore_s=time.monotonic() - t_restore,
+                        restore_s=restore_s,
+                    )
+                    events.emit(
+                        "restore", failed_at_step=failed_at,
+                        restored_step=self._host_step,
+                        restore_ms=round(restore_s * 1e3, 3),
+                        recovery=recoveries,
                     )
         finally:
+            if self.health is not None and self.health.state != "preempted":
+                if sys.exc_info()[0] is not None:
+                    self.health.set("failed")
+                else:
+                    self.health.set("stopped", self.stop.reason)
             g.close()
             self._inflight.clear()
             # generators (incl. DevicePrefetcher streams) drain their

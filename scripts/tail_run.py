@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Render a run journal (obs/events.py JSONL) as a human-readable timeline.
+
+The journal is the machine-readable lifecycle record a supervised run
+leaves behind — run/generation starts and stops, preemption handshakes,
+checkpoint saves/restores/quarantines, fault injections, compile-cache
+traffic. This script is the operator's view of it:
+
+    python scripts/tail_run.py /tmp/run/journal.jsonl          # last 50
+    python scripts/tail_run.py /tmp/run/journal.jsonl -n 0     # everything
+    python scripts/tail_run.py /tmp/run/journal.jsonl --follow # tail -f
+
+Each line renders as
+
+    HH:MM:SS.mmm  gN  pid        event            key=value ...
+
+Stdlib-only and import-light on purpose: usable on a machine that has the
+journal file but not jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from datetime import datetime
+
+#: record keys already rendered in the fixed columns
+_FIXED = ("seq", "ts", "pid", "gen", "event")
+
+
+def format_record(rec: dict) -> str:
+    ts = rec.get("ts")
+    try:
+        clock = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError, OverflowError):
+        clock = "??:??:??.???"
+    gen = rec.get("gen", "?")
+    pid = rec.get("pid", "?")
+    event = rec.get("event", "?")
+    extras = " ".join(
+        f"{k}={rec[k]}" for k in rec if k not in _FIXED and rec[k] is not None
+    )
+    return f"{clock}  g{gen}  {pid:>7}  {event:<20} {extras}".rstrip()
+
+
+def render_line(raw: str) -> str | None:
+    raw = raw.strip()
+    if not raw:
+        return None
+    try:
+        rec = json.loads(raw)
+    except ValueError:
+        return f"?? malformed: {raw[:120]}"
+    if not isinstance(rec, dict):
+        return f"?? malformed: {raw[:120]}"
+    return format_record(rec)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="pretty-print a dist_mnist_tpu run journal")
+    parser.add_argument("journal", help="path to the JSONL journal file")
+    parser.add_argument("-n", type=int, default=50,
+                        help="show the last N records (0 = all; default 50)")
+    parser.add_argument("--follow", "-f", action="store_true",
+                        help="keep the file open and stream new records")
+    args = parser.parse_args(argv)
+
+    try:
+        fh = open(args.journal, "r", encoding="utf-8")
+    except OSError as e:
+        print(f"tail_run: {e}", file=sys.stderr)
+        return 1
+    with fh:
+        lines = fh.readlines()
+        if args.n > 0:
+            lines = lines[-args.n:]
+        for raw in lines:
+            out = render_line(raw)
+            if out:
+                print(out)
+        if not args.follow:
+            return 0
+        try:
+            while True:
+                raw = fh.readline()
+                if not raw:
+                    time.sleep(0.25)
+                    continue
+                out = render_line(raw)
+                if out:
+                    print(out, flush=True)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

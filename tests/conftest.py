@@ -61,8 +61,11 @@ def _no_leaked_prefetch_workers():
     child after launch() returned would outlive the test and poison the
     next one's port/coordinator), compile-cache atomic-write temp files
     (compilecache/store.py `_PENDING_TMP` — a pending entry means a save
-    path skipped its finally), and warm-start/coldstart temp dirs created
-    OUTSIDE pytest's tmp root (launch()'s supervisor mkdtemp and
+    path skipped its finally), metrics-exporter HTTP threads/sockets
+    (``ObsExporter*`` serve threads and obs/exporter.py's
+    ``_LIVE_EXPORTERS`` — an unclosed exporter holds a bound port for the
+    rest of the session), and warm-start/coldstart/journal temp dirs
+    created OUTSIDE pytest's tmp root (launch()'s supervisor mkdtemp and
     bench.py's coldstart pair dir must clean up after themselves). Polls
     briefly: a worker that JUST saw its stop flag may still be mid-exit
     when the test returns."""
@@ -75,7 +78,8 @@ def _no_leaked_prefetch_workers():
     from dist_mnist_tpu.data.prefetch import THREAD_NAME_PREFIX
 
     tmp_root = Path(tempfile.gettempdir())
-    _stray_globs = ("dist_mnist_warmstart_*", "bench_coldstart_*")
+    _stray_globs = ("dist_mnist_warmstart_*", "bench_coldstart_*",
+                    "dist_mnist_journal_*")
     before = {p for g in _stray_globs for p in tmp_root.glob(g)}
     yield
     deadline = time.monotonic() + 2.0
@@ -85,7 +89,12 @@ def _no_leaked_prefetch_workers():
                   if t.is_alive()
                   and (t.name.startswith(THREAD_NAME_PREFIX)
                        or t.name.startswith("Fault")
-                       or t.name.startswith("CompileCache"))]
+                       or t.name.startswith("CompileCache")
+                       or t.name.startswith("ObsExporter"))]
+        exporter_mod = sys.modules.get("dist_mnist_tpu.obs.exporter")
+        if exporter_mod is not None:
+            leaked += [f"open exporter port={e.port}"
+                       for e in exporter_mod._LIVE_EXPORTERS]
         launch_mod = sys.modules.get("dist_mnist_tpu.cli.launch")
         if launch_mod is not None:
             leaked += [f"child pid={p.pid}" for p in launch_mod._LIVE_CHILDREN

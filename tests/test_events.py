@@ -1,0 +1,178 @@
+"""Run journal (obs/events.py): record shape, ordering, the shared-file
+multi-generation contract, the module-level current-journal seam, and the
+scripts/tail_run.py renderer."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.obs.events import (
+    RunJournal,
+    read_journal,
+    tail_journal,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_journal():
+    """These tests install/remove the process-wide journal; never let one
+    leak into (or in from) another test."""
+    prev = events.set_journal(None)
+    yield
+    events.set_journal(prev)
+
+
+def test_record_shape_and_seq(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with RunJournal(path, generation=3) as j:
+        j.emit("run_start", config="mlp_mnist")
+        j.emit("checkpoint_save", step=10)
+    recs = read_journal(path)
+    assert [r["event"] for r in recs] == ["run_start", "checkpoint_save"]
+    assert [r["seq"] for r in recs] == [0, 1]
+    for r in recs:
+        assert r["pid"] == os.getpid()
+        assert r["gen"] == 3
+        assert isinstance(r["ts"], float)
+    assert recs[0]["config"] == "mlp_mnist"
+    assert recs[1]["step"] == 10
+
+
+def test_records_are_single_compact_lines(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with RunJournal(path) as j:
+        j.emit("x", nested={"a": 1}, obj=object())  # default=str coverage
+    lines = path.read_text().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["nested"] == {"a": 1}
+
+
+def test_shared_file_across_generations(tmp_path):
+    """The supervisor contract: one file, many writers over time — each
+    generation appends, nothing is truncated."""
+    path = tmp_path / "j.jsonl"
+    for gen in range(3):
+        with RunJournal(path, generation=gen) as j:
+            j.emit("run_start")
+            j.emit("run_stop")
+    recs = read_journal(path)
+    assert [r["gen"] for r in recs] == [0, 0, 1, 1, 2, 2]
+    # seq restarts per journal instance; (gen, seq) orders the whole file
+    assert [r["seq"] for r in recs] == [0, 1] * 3
+
+
+def test_gen_field_override(tmp_path):
+    """Supervisor records carry the generation as an explicit field (one
+    journal instance spans all attempts)."""
+    path = tmp_path / "j.jsonl"
+    with RunJournal(path) as j:
+        j.emit("generation_start", gen=2)
+    assert read_journal(path)[0]["gen"] == 2
+
+
+def test_emit_without_journal_is_noop():
+    events.emit("nobody_listening", x=1)  # must not raise
+    assert events.get_journal() is None
+
+
+def test_set_journal_returns_previous(tmp_path):
+    a = RunJournal(tmp_path / "a.jsonl")
+    b = RunJournal(tmp_path / "b.jsonl")
+    try:
+        assert events.set_journal(a) is None
+        assert events.set_journal(b) is a
+        events.emit("hello")
+        assert events.set_journal(None) is b
+        assert [r["event"] for r in read_journal(tmp_path / "b.jsonl")] == [
+            "hello"]
+        assert read_journal(tmp_path / "a.jsonl") == []
+    finally:
+        a.close()
+        b.close()
+
+
+def test_emit_after_close_is_safe(tmp_path):
+    j = RunJournal(tmp_path / "j.jsonl")
+    j.close()
+    j.close()  # idempotent
+    events.set_journal(j)
+    events.emit("late")  # swallowed, never raises
+    assert read_journal(tmp_path / "j.jsonl") == []
+
+
+def test_read_journal_skips_malformed(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with RunJournal(path) as j:
+        j.emit("good")
+    with open(path, "a") as fh:
+        fh.write("{torn line\n")
+    with RunJournal(path) as j:
+        j.emit("also_good")
+    assert [r["event"] for r in read_journal(path)] == ["good", "also_good"]
+
+
+def test_read_missing_file():
+    assert read_journal("/no/such/journal.jsonl") == []
+
+
+def test_tail_journal(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with RunJournal(path) as j:
+        for i in range(10):
+            j.emit("e", i=i)
+    assert [r["i"] for r in tail_journal(path, 3)] == [7, 8, 9]
+    assert len(tail_journal(path, 0)) == 10  # 0 = everything
+    assert len(tail_journal(path, -1)) == 10
+
+
+def test_concurrent_emits_no_torn_lines(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with RunJournal(path) as j:
+        threads = [
+            threading.Thread(
+                target=lambda k=k: [j.emit("t", worker=k, n=i)
+                                    for i in range(200)])
+            for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    recs = read_journal(path)
+    assert len(recs) == 800
+    assert sorted(r["seq"] for r in recs) == list(range(800))
+
+
+def test_tail_run_script(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with RunJournal(path, generation=1) as j:
+        j.emit("run_start", config="mlp_mnist")
+        j.emit("preemption", step=40)
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "tail_run.py"), str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert len(lines) == 2
+    assert "run_start" in lines[0] and "config=mlp_mnist" in lines[0]
+    assert "preemption" in lines[1] and "step=40" in lines[1]
+    assert "g1" in lines[0]
+
+
+def test_tail_run_script_missing_file(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "tail_run.py"),
+         str(tmp_path / "absent.jsonl")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 1
+    assert "tail_run" in out.stderr

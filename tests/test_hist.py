@@ -1,0 +1,141 @@
+"""Streaming histogram (obs/hist.py): bucket ladder, quantile error
+bounds, merge algebra, thread safety, and the representative-values
+bridge back to the raw-array writer protocol."""
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from dist_mnist_tpu.obs.hist import StreamingHistogram
+
+
+def test_empty_snapshot_is_nan():
+    h = StreamingHistogram()
+    snap = h.snapshot()
+    assert snap["count"] == 0
+    assert snap["sum"] == 0.0
+    for k in ("mean", "min", "max", "p50", "p95", "p99"):
+        assert math.isnan(snap[k]), k
+    assert math.isnan(h.quantile(0.5))
+
+
+def test_exact_count_sum_min_max():
+    h = StreamingHistogram()
+    values = [0.5, 1.0, 2.5, 100.0, 3.7]
+    h.observe_many(values)
+    assert h.count == len(values)
+    assert h.sum == pytest.approx(sum(values))
+    snap = h.snapshot()
+    assert snap["min"] == 0.5
+    assert snap["max"] == 100.0
+    assert snap["mean"] == pytest.approx(np.mean(values))
+
+
+def test_quantiles_within_relative_error_bound():
+    # default ladder: 10% bucket growth => <=10% relative quantile error
+    rng = np.random.default_rng(0)
+    values = rng.lognormal(mean=2.0, sigma=1.0, size=5000)
+    h = StreamingHistogram()
+    h.observe_many(values)
+    for q in (0.5, 0.95, 0.99):
+        exact = float(np.quantile(values, q))
+        approx = h.quantile(q)
+        assert abs(approx - exact) / exact < 0.11, (q, exact, approx)
+
+
+def test_quantile_clamped_to_observed_range():
+    h = StreamingHistogram()
+    h.observe_many([5.0] * 100)
+    # a single-value distribution: every quantile IS that value, not the
+    # bucket's upper edge
+    assert h.quantile(0.5) == 5.0
+    assert h.quantile(0.99) == 5.0
+
+
+def test_underflow_and_overflow_values_are_counted():
+    h = StreamingHistogram(min_value=1.0, growth=2.0, n_buckets=8)
+    h.observe(0.0)       # underflow bucket
+    h.observe(-3.0)      # negative -> underflow bucket
+    h.observe(1e12)      # overflow bucket
+    assert h.count == 3
+    snap = h.snapshot()
+    assert snap["min"] == -3.0
+    assert snap["max"] == 1e12
+
+
+def test_nan_observations_are_skipped():
+    h = StreamingHistogram()
+    h.observe(float("nan"))
+    h.observe(1.0)
+    assert h.count == 1
+
+
+def test_merge_equivalent_to_combined_stream():
+    rng = np.random.default_rng(1)
+    a_vals = rng.exponential(10.0, size=500)
+    b_vals = rng.exponential(50.0, size=700)
+    a, b, both = (StreamingHistogram() for _ in range(3))
+    a.observe_many(a_vals)
+    b.observe_many(b_vals)
+    both.observe_many(np.concatenate([a_vals, b_vals]))
+    a.merge(b)
+    assert a.count == both.count
+    assert a.sum == pytest.approx(both.sum)  # summation order differs
+    sa, sb = a.snapshot(), both.snapshot()
+    for k in ("count", "min", "max", "p50", "p95", "p99"):
+        assert sa[k] == sb[k], k
+    assert a.buckets() == both.buckets()
+
+
+def test_merge_rejects_mismatched_ladder():
+    a = StreamingHistogram()
+    b = StreamingHistogram(growth=2.0)
+    with pytest.raises(ValueError):
+        a.merge(b)
+
+
+def test_bad_ladder_rejected():
+    with pytest.raises(ValueError):
+        StreamingHistogram(growth=1.0)
+    with pytest.raises(ValueError):
+        StreamingHistogram(min_value=0.0)
+    with pytest.raises(ValueError):
+        StreamingHistogram(n_buckets=1)
+
+
+def test_thread_safety_exact_count():
+    h = StreamingHistogram()
+    n_threads, per_thread = 8, 2000
+
+    def work(seed):
+        rng = np.random.default_rng(seed)
+        for v in rng.uniform(0.1, 100.0, size=per_thread):
+            h.observe(float(v))
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n_threads * per_thread
+
+
+def test_representative_values_bounded_and_in_range():
+    h = StreamingHistogram()
+    rng = np.random.default_rng(2)
+    vals = rng.uniform(1.0, 1000.0, size=10_000)
+    h.observe_many(vals)
+    rep = h.representative_values(cap=512)
+    assert 0 < len(rep) <= 512
+    assert min(rep) >= h.snapshot()["min"]
+    assert max(rep) <= h.snapshot()["max"]
+    # the reconstructed sample preserves the distribution's location to
+    # within the ladder's resolution
+    assert np.median(rep) == pytest.approx(np.median(vals), rel=0.15)
+
+
+def test_representative_values_empty():
+    assert StreamingHistogram().representative_values() == []

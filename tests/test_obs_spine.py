@@ -1,0 +1,645 @@
+"""Live telemetry spine: registry, Prometheus/healthz/events exposition,
+health state machine, train-loop wiring, tag hygiene, and the
+supervisor's shared run journal."""
+
+import io
+import itertools
+import json
+import re
+import socket
+import sys
+import textwrap
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.obs.events import RunJournal, read_journal
+from dist_mnist_tpu.obs.exporter import (
+    HealthState,
+    MetricsExporter,
+    _prom_name,
+    render_prometheus,
+)
+from dist_mnist_tpu.obs.hist import StreamingHistogram
+from dist_mnist_tpu.obs.registry import MetricRegistry, RegistryWriter
+from dist_mnist_tpu.obs.writers import make_default_writer
+from dist_mnist_tpu.train.loop import PreemptionError, TrainLoop
+from dist_mnist_tpu.train.state import TrainState
+
+#: the repo-wide tag convention (docs/OBSERVABILITY.md): lowercase
+#: namespaced paths, so Prometheus mangling is lossless modulo '/' and '.'
+TAG_RE = re.compile(r"^[a-z0-9_/.]+$")
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_journal():
+    prev = events.set_journal(None)
+    yield
+    events.set_journal(prev)
+
+
+def _get(url, timeout=10):
+    """(status, body) for a GET, without raising on 4xx/5xx."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _state(step=0):
+    return TrainState(
+        step=jnp.int32(step), params={}, model_state={}, opt_state={},
+        rng=jnp.zeros((2,), jnp.uint32),
+    )
+
+
+def _fake_step(state, batch):
+    return (
+        TrainState(step=state.step + 1, params=state.params,
+                   model_state=state.model_state, opt_state=state.opt_state,
+                   rng=state.rng),
+        {"loss": jnp.float32(batch)},
+    )
+
+
+# -- registry -----------------------------------------------------------------
+
+def test_registry_writer_feeds_registry():
+    reg = MetricRegistry()
+    w = RegistryWriter(reg)
+    w.scalar("goodput/fraction", 0.9, step=10)
+    w.scalars({"input/stall_ms": 1.5, "steps_per_sec": 120.0}, step=20)
+    w.histogram("serve/batch_size", [1, 2, 4, 8], step=20)
+    w.flush()
+    scalars = reg.scalars()
+    assert scalars["goodput/fraction"] == (pytest.approx(0.9), 10,
+                                           pytest.approx(scalars[
+                                               "goodput/fraction"][2]))
+    assert scalars["input/stall_ms"][0] == 1.5
+    assert scalars["steps_per_sec"][1] == 20
+    assert reg.histograms()["serve/batch_size"].count == 4
+    snap = reg.snapshot()
+    assert snap["scalars"]["steps_per_sec"] == 120.0
+    assert snap["histograms"]["serve/batch_size"]["count"] == 4
+    assert reg.tags() == sorted(
+        ["goodput/fraction", "input/stall_ms", "steps_per_sec",
+         "serve/batch_size"])
+
+
+def test_registry_attach_histogram_live_reference():
+    reg = MetricRegistry()
+    h = StreamingHistogram()
+    reg.attach_histogram("train/step_time_ms", h)
+    h.observe(5.0)  # owner writes AFTER attach; registry sees it (by ref)
+    assert reg.histograms()["train/step_time_ms"].count == 1
+
+
+def test_make_default_writer_registry_every_process(tmp_path):
+    # chief: registry rides alongside the disk sinks
+    reg = MetricRegistry()
+    w = make_default_writer(str(tmp_path), chief=True, registry=reg)
+    w.scalar("loss", 1.25, step=1)
+    w.flush()
+    w.close()
+    assert reg.scalars()["loss"][0] == 1.25
+    assert (tmp_path / "metrics.csv").exists()
+    # non-chief: NO files, but the local registry still fills (each
+    # process's /metrics serves its own numbers)
+    reg2 = MetricRegistry()
+    out2 = tmp_path / "nonchief"
+    out2.mkdir()
+    w2 = make_default_writer(str(out2), chief=False, registry=reg2)
+    w2.scalar("loss", 2.5, step=1)
+    w2.flush()
+    w2.close()
+    assert reg2.scalars()["loss"][0] == 2.5
+    assert not list(out2.iterdir())
+
+
+# -- prometheus rendering -----------------------------------------------------
+
+#: valid exposition lines: HELP/TYPE comments, or `name[{labels}] value`
+_PROM_LINE = re.compile(
+    r"^(# (TYPE|HELP) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+)$")
+
+
+def test_render_prometheus_is_valid_text():
+    reg = MetricRegistry()
+    reg.set_scalar("goodput/fraction", 0.875, step=5)
+    reg.set_scalar("serve/queue_depth", 3, step=5)
+    h = StreamingHistogram()
+    h.observe_many([0.5, 1.0, 5.0, 1e12])  # incl. an overflow-bucket value
+    reg.attach_histogram("train/step_time_ms", h)
+    body = render_prometheus(reg, HealthState("training"))
+    lines = body.strip().splitlines()
+    for line in lines:
+        assert _PROM_LINE.match(line), f"invalid exposition line: {line!r}"
+    assert "goodput_fraction 0.875" in lines
+    # histogram: cumulative buckets, exactly one +Inf, sum+count present
+    bucket_lines = [l for l in lines
+                    if l.startswith("train_step_time_ms_bucket")]
+    cums = [int(l.rsplit(" ", 1)[1]) for l in bucket_lines]
+    assert cums == sorted(cums), "bucket counts must be cumulative"
+    assert cums[-1] == h.count
+    assert sum('le="+Inf"' in l for l in bucket_lines) == 1
+    assert any(l.startswith("train_step_time_ms_sum ") for l in lines)
+    assert "train_step_time_ms_count 4" in lines
+    # health gauges
+    assert "process_healthy 1" in lines
+    assert 'process_state{state="training"} 1' in lines
+    assert 'process_state{state="failed"} 0' in lines
+
+
+def test_prom_name_mangling_is_total():
+    for ugly in ("serve/latency_ms", "a.b-c d", "9starts_with_digit", "", "é"):
+        name = _prom_name(ugly)
+        assert re.match(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$", name), (ugly, name)
+
+
+# -- health state machine -----------------------------------------------------
+
+def test_health_state_machine():
+    h = HealthState()
+    assert h.state == "starting" and h.healthy
+    h.set("training")
+    assert h.healthy
+    h.set("draining", "shutdown requested")
+    assert not h.healthy
+    snap = h.snapshot()
+    assert snap["state"] == "draining"
+    assert snap["detail"] == "shutdown requested"
+    assert snap["since_s"] >= 0
+    with pytest.raises(ValueError):
+        h.set("confused")
+
+
+# -- exporter http endpoints --------------------------------------------------
+
+def test_exporter_endpoints(tmp_path):
+    reg = MetricRegistry()
+    reg.set_scalar("goodput/fraction", 1.0, step=1)
+    health = HealthState("training", generation=2)
+    jpath = tmp_path / "j.jsonl"
+    with RunJournal(jpath) as j:
+        for i in range(5):
+            j.emit("checkpoint_save", step=i)
+    with MetricsExporter(reg, health=health, journal_path=str(jpath),
+                         port=0) as exp:
+        assert exp.port > 0  # ephemeral port was resolved
+        code, body = _get(exp.url("/metrics"))
+        assert code == 200
+        assert "goodput_fraction 1.0" in body
+        code, body = _get(exp.url("/healthz"))
+        assert code == 200
+        snap = json.loads(body)
+        assert snap["state"] == "training" and snap["generation"] == 2
+        # unhealthy states flip to 503 so a router can react
+        health.set("draining")
+        code, body = _get(exp.url("/healthz"))
+        assert code == 503
+        assert json.loads(body)["state"] == "draining"
+        # journal tail as NDJSON, bounded by ?n=
+        code, body = _get(exp.url("/events?n=2"))
+        assert code == 200
+        recs = [json.loads(l) for l in body.strip().splitlines()]
+        assert [r["step"] for r in recs] == [3, 4]
+        code, _ = _get(exp.url("/nope"))
+        assert code == 404
+    # context-manager close: thread + socket gone (conftest leak-check
+    # double-covers this)
+    from dist_mnist_tpu.obs.exporter import _LIVE_EXPORTERS
+    assert exp not in _LIVE_EXPORTERS
+
+
+def test_exporter_events_without_journal():
+    with MetricsExporter(MetricRegistry(), port=0) as exp:
+        code, body = _get(exp.url("/events"))
+        assert code == 404
+        assert "no journal" in body
+        # /healthz without a HealthState: 200 "unknown" (exposition-only
+        # processes still answer liveness probes)
+        code, body = _get(exp.url("/healthz"))
+        assert code == 200
+        assert json.loads(body)["state"] == "unknown"
+
+
+def test_exporter_bind_conflict_raises_oserror():
+    with MetricsExporter(MetricRegistry(), port=0) as exp:
+        with pytest.raises(OSError):
+            MetricsExporter(MetricRegistry(), port=exp.port).start()
+
+
+# -- train loop wiring --------------------------------------------------------
+
+def test_loop_health_transitions_clean_run():
+    health = HealthState()
+    seen = []
+
+    class Watch:
+        def begin(self, loop):
+            pass
+
+        def before_step(self, step):
+            pass
+
+        def after_step(self, step, state, outputs):
+            seen.append(health.state)
+
+        def end(self, state):
+            pass
+
+    from dist_mnist_tpu.hooks import StopAtStepHook
+
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                     [Watch(), StopAtStepHook(last_step=3)], health=health)
+    loop.run()
+    assert seen == ["training"] * 3
+    assert health.state == "stopped"
+    assert health.snapshot()["detail"] == "reached last step"
+
+
+def test_loop_health_failed_on_error():
+    def bad_step(state, batch):
+        raise RuntimeError("boom")
+
+    health = HealthState()
+    loop = TrainLoop(bad_step, _state(), itertools.repeat(1.0), [],
+                     health=health)
+    with pytest.raises(RuntimeError):
+        loop.run()
+    assert health.state == "failed"
+
+
+def test_loop_health_preempted_and_journal(tmp_path):
+    class Notice:
+        reason = "spot reclaim"
+        _hits = 0
+
+        def requested(self):
+            Notice._hits += 1
+            return Notice._hits > 3  # preempt before the 4th step
+
+    class MemCkpt:
+        saved = None
+
+        def save(self, state):
+            MemCkpt.saved = state
+
+        def wait(self):
+            pass
+
+        def restore(self, target):
+            return MemCkpt.saved
+
+    from dist_mnist_tpu.hooks import StopAtStepHook
+
+    health = HealthState()
+    jpath = tmp_path / "j.jsonl"
+    prev = events.set_journal(RunJournal(jpath))
+    try:
+        loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                         [StopAtStepHook(last_step=100)],
+                         checkpoint_manager=MemCkpt(), preemption=Notice(),
+                         health=health)
+        final = loop.run()
+    finally:
+        events.set_journal(prev).close()
+    assert health.state == "preempted"
+    assert loop.preempted_at == final.step_int == 3
+    recs = read_journal(jpath)
+    pre = [r for r in recs if r["event"] == "preemption"]
+    assert len(pre) == 1
+    assert pre[0]["step"] == 3
+    assert pre[0]["reason"] == "spot reclaim"
+    assert pre[0]["checkpoint_saved"] is True
+
+
+def test_loop_journal_restore_events(tmp_path):
+    """A recovered failure leaves a `restore` record matching goodput."""
+    class Flaky:
+        calls = 0
+
+        def __call__(self, state, batch):
+            Flaky.calls += 1
+            if Flaky.calls == 3:
+                raise PreemptionError("fake")
+            return _fake_step(state, batch)
+
+    class MemCkpt:
+        saved = None
+
+        def save(self, state):
+            MemCkpt.saved = state
+
+        def restore(self, target):
+            return MemCkpt.saved
+
+    from dist_mnist_tpu.hooks import StopAtStepHook
+
+    mgr = MemCkpt()
+    mgr.save(_state())
+    jpath = tmp_path / "j.jsonl"
+    prev = events.set_journal(RunJournal(jpath))
+    try:
+        loop = TrainLoop(Flaky(), _state(), itertools.repeat(1.0),
+                         [StopAtStepHook(last_step=5)],
+                         checkpoint_manager=mgr, max_recoveries=2)
+        loop.run()
+    finally:
+        events.set_journal(prev).close()
+    restores = [r for r in read_journal(jpath) if r["event"] == "restore"]
+    assert len(restores) == loop.goodput.snapshot()["recoveries"] == 1
+    assert restores[0]["failed_at_step"] == 2
+    assert restores[0]["restored_step"] == 0
+
+
+def test_loop_step_time_histogram_fills():
+    from dist_mnist_tpu.hooks import StopAtStepHook
+
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                     [StopAtStepHook(last_step=10)])
+    loop.run()
+    assert loop.step_time_hist.count == 10
+    assert loop.step_time_hist.snapshot()["p50"] > 0
+
+
+def test_step_time_hook_publishes_percentiles():
+    from dist_mnist_tpu.hooks import StepTimeHook, StopAtStepHook
+
+    reg = MetricRegistry()
+    hook = StepTimeHook(RegistryWriter(reg), every_steps=4)
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                     [hook, StopAtStepHook(last_step=8)])
+    loop.run()
+    scalars = reg.scalars()
+    for tag in ("step_time/p50_ms", "step_time/p95_ms", "step_time/p99_ms",
+                "step_time/mean_ms"):
+        assert tag in scalars, sorted(scalars)
+        assert scalars[tag][0] > 0
+
+
+# -- live scrape during a (fake) run ------------------------------------------
+
+def test_metrics_scrape_mid_run():
+    """The acceptance shape, in miniature: /metrics serves the live
+    step-time histogram and /healthz says `training` WHILE the loop runs."""
+    from dist_mnist_tpu.hooks import StopAtStepHook
+
+    reg = MetricRegistry()
+    health = HealthState()
+    scraped = {}
+
+    with MetricsExporter(reg, health=health, port=0) as exp:
+        class Scrape:
+            def begin(self, loop):
+                reg.attach_histogram("train/step_time_ms",
+                                     loop.step_time_hist)
+
+            def before_step(self, step):
+                pass
+
+            def after_step(self, step, state, outputs):
+                if step == 5 and not scraped:
+                    scraped["metrics"] = _get(exp.url("/metrics"))
+                    scraped["healthz"] = _get(exp.url("/healthz"))
+
+            def end(self, state):
+                pass
+
+        loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                         [Scrape(), StopAtStepHook(last_step=8)],
+                         health=health)
+        loop.run()
+
+    code, body = scraped["metrics"]
+    assert code == 200
+    assert "# TYPE train_step_time_ms histogram" in body
+    count_line = [l for l in body.splitlines()
+                  if l.startswith("train_step_time_ms_count")][0]
+    # step 5's own timing lands AFTER the after_step hooks, so the live
+    # scrape sees the 4 already-completed steps
+    assert int(count_line.split()[1]) == 4
+    code, body = scraped["healthz"]
+    assert code == 200
+    assert json.loads(body)["state"] == "training"
+    assert health.state == "stopped"
+
+
+# -- tag hygiene --------------------------------------------------------------
+
+class _TagRecorder:
+    """Writer that records every tag it is asked to publish."""
+
+    def __init__(self):
+        self.tags = set()
+
+    def scalar(self, tag, value, step):
+        self.tags.add(tag)
+
+    def scalars(self, values, step):
+        self.tags.update(values)
+
+    def histogram(self, tag, values, step):
+        self.tags.add(tag)
+
+    def flush(self):
+        pass
+
+
+def test_serve_metrics_tags_are_hygienic():
+    from dist_mnist_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    m.record_latency(3.0)
+    m.record_batch(4, 8)
+    rec = _TagRecorder()
+    m.emit(rec, step=1, queue_depth=2,
+           cache={"hits": 1, "misses": 0})
+    assert rec.tags, "emit published nothing"
+    for tag in rec.tags:
+        assert TAG_RE.match(tag), f"non-hygienic serve tag {tag!r}"
+
+
+def test_step_time_hook_tags_are_hygienic():
+    from dist_mnist_tpu.hooks import StepTimeHook, StopAtStepHook
+
+    rec = _TagRecorder()
+    loop = TrainLoop(_fake_step, _state(), itertools.repeat(1.0),
+                     [StepTimeHook(rec, every_steps=2),
+                      StopAtStepHook(last_step=4)])
+    loop.run()
+    for tag in rec.tags:
+        assert TAG_RE.match(tag), f"non-hygienic step-time tag {tag!r}"
+
+
+# -- supervisor journal -------------------------------------------------------
+
+_ENV_STUB = textwrap.dedent("""\
+    import json, os, sys
+    args = dict(a.split("=", 1) for a in sys.argv[1:]
+                if a.startswith("--") and "=" in a)
+    pid = int(args.get("--process_id", "0"))
+    out = args["--envlog"] + f".p{pid}"
+    with open(out, "a") as fh:
+        fh.write(json.dumps({
+            "journal": os.environ.get("DIST_MNIST_TPU_JOURNAL"),
+            "generation": os.environ.get("DIST_MNIST_TPU_GENERATION"),
+        }) + "\\n")
+    if pid == 1 and args.get("--stub_mode") == "fail_once":
+        marker = args["--stub_marker"]
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(3)
+    sys.exit(0)
+""")
+
+
+def _supervise(tmp_path, train_args, **kw):
+    import contextlib
+
+    from dist_mnist_tpu.cli.launch import launch
+
+    stub = tmp_path / "env_stub.py"
+    stub.write_text(_ENV_STUB)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = launch(2, train_args, platform="cpu", devices_per_process=1,
+                    child_command=[sys.executable, str(stub)],
+                    restart_backoff_s=0.05, **kw)
+    return rc, buf.getvalue()
+
+
+def test_supervisor_owns_one_journal_across_generations(tmp_path):
+    jpath = tmp_path / "journal.jsonl"
+    rc, log = _supervise(
+        tmp_path,
+        [f"--envlog={tmp_path / 'env'}", "--stub_mode=fail_once",
+         f"--stub_marker={tmp_path / 'marker'}"],
+        max_restarts=2, journal=str(jpath),
+    )
+    assert rc == 0, log
+    recs = read_journal(jpath)
+    evs = [r["event"] for r in recs]
+    # the complete lifecycle, in order, in ONE file
+    assert evs == [
+        "supervisor_start",
+        "generation_start", "generation_end",
+        "supervisor_restart",
+        "generation_start", "generation_end",
+        "supervisor_stop",
+    ], evs
+    by_ev = {e: [r for r in recs if r["event"] == e] for e in set(evs)}
+    assert by_ev["supervisor_start"][0]["max_restarts"] == 2
+    assert [r["gen"] for r in by_ev["generation_start"]] == [0, 1]
+    assert by_ev["generation_end"][0]["rc"] == 3
+    assert by_ev["generation_end"][0]["first_dead"] == 1
+    assert by_ev["generation_end"][1]["rc"] == 0
+    assert by_ev["supervisor_restart"][0]["attempt"] == 1
+    assert by_ev["supervisor_stop"][0] == {
+        **by_ev["supervisor_stop"][0], "rc": 0, "restarts": 1}
+    # children of BOTH generations were pointed at the same journal with
+    # their generation number (the env injection contract)
+    for pid in (0, 1):
+        lines = (tmp_path / f"env.p{pid}").read_text().strip().splitlines()
+        envs = [json.loads(l) for l in lines]
+        assert [e["generation"] for e in envs] == ["0", "1"]
+        assert all(e["journal"] == str(jpath) for e in envs)
+
+
+_SLEEP_STUB = textwrap.dedent("""\
+    import sys, time
+    args = dict(a.split("=", 1) for a in sys.argv[1:]
+                if a.startswith("--") and "=" in a)
+    time.sleep(2.0 if int(args.get("--process_id", "0")) == 1 else 0.8)
+    sys.exit(0)
+""")
+
+
+def test_supervisor_journals_chaos_kill(tmp_path):
+    import contextlib
+
+    from dist_mnist_tpu.cli.launch import launch
+
+    jpath = tmp_path / "journal.jsonl"
+    stub = tmp_path / "sleep_stub.py"
+    stub.write_text(_SLEEP_STUB)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        launch(2, [], platform="cpu", devices_per_process=1,
+               child_command=[sys.executable, str(stub)],
+               restart_backoff_s=0.05, max_restarts=1,
+               journal=str(jpath), kill_spec=(1, 0.2))
+    log = buf.getvalue()
+    assert "fault injected: SIGKILL p1" in log, log
+    kills = [r for r in read_journal(jpath)
+             if r["event"] == "fault_injected"]
+    assert len(kills) == 1
+    assert kills[0]["kind"] == "kill_process"
+    assert kills[0]["process"] == 1
+    assert kills[0]["gen"] == 0
+
+
+# -- end to end through the driver -------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_run_config_obs_spine_end_to_end(tmp_path):
+    """run_config wires the whole spine: journal, registry in the default
+    writer, /metrics + /healthz live during training, hygienic tags."""
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.cli.train import run_config
+
+    cfg = get_config("mlp_mnist", train_steps=6, eval_every=0, log_every=2)
+    port = _free_port()
+    scraped = {}
+
+    class Scrape:
+        def begin(self, loop):
+            pass
+
+        def before_step(self, step):
+            pass
+
+        def after_step(self, step, state, outputs):
+            if step >= 2 and not scraped:
+                scraped["metrics"] = _get(f"http://127.0.0.1:{port}/metrics")
+                scraped["healthz"] = _get(f"http://127.0.0.1:{port}/healthz")
+
+        def end(self, state):
+            pass
+
+    state, final, ctx = run_config(
+        cfg, data_dir=str(tmp_path / "data"), logdir=str(tmp_path / "logs"),
+        metrics_port=port, extra_hooks=[Scrape()],
+    )
+    assert state.step_int == 6
+    # live scrape saw the training state and the step-time histogram
+    code, body = scraped["metrics"]
+    assert code == 200
+    assert "# TYPE train_step_time_ms histogram" in body
+    code, body = scraped["healthz"]
+    assert code == 200 and json.loads(body)["state"] == "training"
+    # the registry rides in ctx, fully hygienic
+    assert ctx["health"].state == "stopped"
+    tags = ctx["registry"].tags()
+    assert "train/step_time_ms" in tags
+    for tag in tags:
+        assert TAG_RE.match(tag), f"non-hygienic tag {tag!r}"
+    # the journal landed in the logdir with the run lifecycle
+    recs = read_journal(tmp_path / "logs" / "events.jsonl")
+    evs = [r["event"] for r in recs]
+    assert evs[0] == "run_start" and evs[-1] == "run_stop"
+    assert recs[-1]["ok"] is True
+    assert ctx["journal"] == str(tmp_path / "logs" / "events.jsonl")

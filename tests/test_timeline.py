@@ -122,3 +122,51 @@ def test_summarize_synthetic_trace(tmp_path):
     assert rows[0] == {"name": "matmul", "total_us": 40.0, "count": 2,
                        "avg_us": 20.0}
     assert rows[1]["name"] == "relu"
+
+
+def test_summarize_trace_without_trace_events(tmp_path):
+    """A trace with no `traceEvents` key (or an empty list) summarizes to
+    no rows — not a KeyError mid-triage."""
+    p1 = tmp_path / "empty.json"
+    p1.write_text("{}")
+    assert summarize_trace(p1) == []
+    p2 = tmp_path / "no_complete.json"
+    p2.write_text(json.dumps({"traceEvents": []}))
+    assert summarize_trace(p2) == []
+    # metadata-only events (no ph=X / no dur) likewise aggregate to nothing
+    p3 = tmp_path / "meta.json"
+    p3.write_text(json.dumps({"traceEvents": [
+        {"ph": "M", "name": "process_name"},
+        {"ph": "X", "name": "no-dur"},
+    ]}))
+    assert summarize_trace(p3) == []
+
+
+def test_profiler_hook_survives_export_failure(tmp_path, monkeypatch, caplog):
+    """export_chrome_trace raising must not take the run down: the hook
+    logs and the trace window still closes cleanly."""
+    import logging
+
+    from dist_mnist_tpu.hooks.builtin import ProfilerHook
+    from dist_mnist_tpu.obs import timeline
+
+    def boom(logdir, out_path=None):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(timeline, "export_chrome_trace", boom)
+
+    class FakeLoop:
+        initial_step = 0
+
+    hook = ProfilerHook(str(tmp_path), start_step=0, num_steps=1)
+    hook.begin(FakeLoop())
+    hook.before_step(0)
+    x = jnp.ones((32, 32))
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    with caplog.at_level(logging.ERROR, "dist_mnist_tpu.hooks.builtin"):
+        hook.after_step(1, None, {"loss": x[0, 0]})  # closes + export fails
+    assert not hook._active and hook._done
+    assert "chrome trace export failed" in caplog.text
+    # the window itself was captured; only the convenience export failed
+    assert latest_trace(tmp_path) is not None
+    hook.end(None)  # and end() after a completed window is a no-op

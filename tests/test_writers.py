@@ -79,3 +79,92 @@ def test_default_writer_non_chief_is_silent(tmp_path):
     w.scalar("x", 1.0, 0)
     w.histogram("y", np.zeros(2), 0)  # must not raise
     assert not list(tmp_path.iterdir())
+
+
+def test_multi_writer_histogram_degrades_to_summary_scalars():
+    """A scalar-only writer in the fan-out gets summary-stat scalars for a
+    histogram write instead of crashing — same contract as `scalars`."""
+    scalar_calls = []
+    hist_calls = []
+
+    class ScalarOnly:
+        def scalar(self, tag, value, step):
+            scalar_calls.append((tag, value, step))
+
+        def flush(self):
+            pass
+
+    class Full:
+        def scalar(self, tag, value, step):
+            raise AssertionError("full writer must get the raw histogram")
+
+        def histogram(self, tag, values, step):
+            hist_calls.append((tag, step))
+
+        def flush(self):
+            pass
+
+    m = MultiWriter(ScalarOnly(), Full())
+    m.histogram("lat", np.array([1.0, 2.0, 3.0]), 7)
+    assert hist_calls == [("lat", 7)]
+    by_tag = {t: v for t, v, _ in scalar_calls}
+    assert all(s == 7 for _, _, s in scalar_calls)
+    assert set(by_tag) == {"lat/count", "lat/mean", "lat/std", "lat/min",
+                           "lat/max"}
+    assert by_tag["lat/count"] == 3.0
+    assert by_tag["lat/mean"] == 2.0
+
+
+def test_csv_writer_flush_cadence(tmp_path):
+    """Rows become durable on disk every FLUSH_EVERY writes without an
+    explicit flush() — bounds the window lost at abnormal exit."""
+    path = tmp_path / "m.csv"
+    w = CsvWriter(path)
+    try:
+        for i in range(CsvWriter.FLUSH_EVERY - 1):
+            w.scalar("a", float(i), i)
+        # still buffered (header was flushed-through by open; rows may sit
+        # in the stdio buffer) — one more write crosses the threshold
+        w.scalar("a", 99.0, 99)
+        rows = _read_csv(path)  # read WITHOUT flush/close
+        assert len(rows) == CsvWriter.FLUSH_EVERY
+        assert w._unflushed == 0
+        # batched writes count per-row toward the cadence, not per-call
+        w.scalars({f"t{i}": float(i) for i in range(CsvWriter.FLUSH_EVERY)},
+                  step=1)
+        assert w._unflushed == 0
+        assert len(_read_csv(path)) == 2 * CsvWriter.FLUSH_EVERY
+    finally:
+        w.close()
+
+
+def test_csv_writer_close_flushes_and_is_idempotent(tmp_path):
+    path = tmp_path / "m.csv"
+    w = CsvWriter(path)
+    w.scalar("loss", 0.25, 3)  # below cadence: only durable via close()
+    w.close()
+    assert _read_csv(path) == [{"step": "3", "tag": "loss", "value": "0.25"}]
+    w.close()  # idempotent
+    w.flush()  # post-close flush must not raise either
+
+
+def test_multi_writer_close_propagates(tmp_path):
+    """close() closes writers that support it and flushes the rest, so a
+    CsvWriter in the fan-out releases its file handle."""
+    calls = []
+
+    class FlushOnly:
+        def scalar(self, tag, value, step):
+            pass
+
+        def flush(self):
+            calls.append("flush")
+
+    csv_w = CsvWriter(tmp_path / "m.csv")
+    m = MultiWriter(csv_w, FlushOnly())
+    m.scalar("x", 1.0, 0)
+    m.close()
+    assert csv_w._fh.closed
+    assert calls == ["flush"]
+    assert _read_csv(tmp_path / "m.csv") == [
+        {"step": "0", "tag": "x", "value": "1.0"}]
