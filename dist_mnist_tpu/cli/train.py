@@ -140,6 +140,20 @@ flags.DEFINE_string("journal", None,
                     "$DIST_MNIST_TPU_JOURNAL (the supervisor injects a "
                     "shared journal across restart generations), else "
                     "<logdir>/events.jsonl when --logdir is set")
+flags.DEFINE_boolean("overlap", None,
+                     "fsdp comm/compute overlap (parallel/overlap.py): "
+                     "bucketed parameter all-gather prefetch + gradient "
+                     "reduce-scatter flushed while the backward still runs. "
+                     "Needs an fsdp sharding strategy; bit-identical to the "
+                     "serial path (None = config value)")
+flags.DEFINE_float("overlap_bucket_mb", None,
+                   "overlap bucket granularity in MiB: smaller = more "
+                   "chunks in flight, larger = fewer bigger transfers "
+                   "(None = config value)")
+flags.DEFINE_string("overlap_chunk", None,
+                    "overlap chunking mode: all_gather (one collective per "
+                    "bucket leaf) | ring (ppermute double-buffering, "
+                    "collective_matmul-style); None = config value")
 
 
 def build_optimizer(cfg):
@@ -177,6 +191,36 @@ def build_optimizer(cfg):
     if aggregate > 1:
         opt = optim.gradient_accumulation(opt, aggregate)
     return opt
+
+
+def compile_cache_key_fields(cfg, mesh, *, scan_chunk=0,
+                             input_pipeline="python"):
+    """Everything that changes the compiled step program, as a flat dict —
+    the ExecutableStore key is `cache_key({"kind": ..., **fields})`. The
+    overlap knobs are in here so a cached serial executable can never be
+    served to an overlapped run (or vice versa): the two lower to different
+    HLO even though they are value-identical."""
+    return {
+        "config": cfg.name,
+        "model": cfg.model,
+        "model_kwargs": cfg.model_kwargs,
+        "batch_size": cfg.batch_size,
+        "optimizer": cfg.optimizer,
+        "loss": cfg.loss,
+        "remat": cfg.remat,
+        "remat_policy": cfg.remat_policy,
+        "augment": cfg.augment,
+        "mesh": tuple(sorted(mesh.shape.items())),
+        "sharding": cfg.sharding_rules,
+        "overlap": cfg.overlap,
+        "overlap_bucket_mb": cfg.overlap_bucket_mb,
+        "overlap_chunk": cfg.overlap_chunk,
+        "dtype": "float32",
+        "donate": True,
+        "scan_chunk": scan_chunk,
+        "input_pipeline": input_pipeline,
+        "prng": cfg.prng_impl,
+    }
 
 
 def run_config(cfg, **kwargs):
@@ -362,6 +406,18 @@ def _run_train(
             "cannot feed a compiled multi-step scan"
         )
     rules = resolve_rules(cfg.sharding_rules)
+    overlap_cfg = None
+    if cfg.overlap:
+        from dist_mnist_tpu.parallel.overlap import OverlapConfig
+
+        if rules.fsdp_axis is None:
+            raise ValueError(
+                f"--overlap needs an fsdp sharding strategy (got "
+                f"{cfg.sharding_rules!r}): there are no parameter shards "
+                f"to gather — use --sharding=fsdp or fsdp_tp"
+            )
+        overlap_cfg = OverlapConfig(bucket_mb=cfg.overlap_bucket_mb,
+                                    chunk=cfg.overlap_chunk)
     if scan_chunk and cfg.train_steps % scan_chunk:
         stop_at = -(-cfg.train_steps // scan_chunk) * scan_chunk
         log.warning(
@@ -393,24 +449,8 @@ def _run_train(
         cache_root = Path(compile_cache_dir)
         enable_persistent_cache(cache_root / "xla")
         store = ExecutableStore(cache_root / "exe")
-        key_fields = {
-            "config": cfg.name,
-            "model": cfg.model,
-            "model_kwargs": cfg.model_kwargs,
-            "batch_size": cfg.batch_size,
-            "optimizer": cfg.optimizer,
-            "loss": cfg.loss,
-            "remat": cfg.remat,
-            "remat_policy": cfg.remat_policy,
-            "augment": cfg.augment,
-            "mesh": tuple(sorted(mesh.shape.items())),
-            "sharding": cfg.sharding_rules,
-            "dtype": "float32",
-            "donate": True,
-            "scan_chunk": scan_chunk,
-            "input_pipeline": input_pipeline,
-            "prng": cfg.prng_impl,
-        }
+        key_fields = compile_cache_key_fields(
+            cfg, mesh, scan_chunk=scan_chunk, input_pipeline=input_pipeline)
         step_key = lambda kind: cache_key({"kind": kind, **key_fields})  # noqa: E731
 
     rng = jax.random.PRNGKey(cfg.seed)
@@ -459,6 +499,7 @@ def _run_train(
                     model, optimizer, mesh, dd, cfg.batch_size, scan_chunk,
                     loss_fn=loss_fn, rules=rules, remat=cfg.remat,
                     augment=cfg.augment, remat_policy=cfg.remat_policy,
+                    overlap=overlap_cfg,
                     store=store, cache_key=step_key("scan"),
                 )
             else:
@@ -466,6 +507,7 @@ def _run_train(
                     model, optimizer, mesh, dd, cfg.batch_size,
                     loss_fn=loss_fn, rules=rules, remat=cfg.remat,
                     augment=cfg.augment, remat_policy=cfg.remat_policy,
+                    overlap=overlap_cfg,
                     store=store, cache_key=step_key("fused"),
                 )
             step_fn = lambda state, _batch: run(state)
@@ -477,6 +519,7 @@ def _run_train(
                                       rules=rules, remat=cfg.remat,
                                       augment=cfg.augment,
                                       remat_policy=cfg.remat_policy,
+                                      overlap=overlap_cfg,
                                       store=store, cache_key=step_key("train"))
         eval_step = make_eval_step(model, mesh, store=store,
                                    cache_key=step_key("eval"))
@@ -498,6 +541,12 @@ def _run_train(
             hooks_lib.MemoryHook(writer, every_steps=cfg.log_every),
             hooks_lib.NaNGuardHook(),
         ]
+        if overlap_cfg is not None:
+            from dist_mnist_tpu.parallel.overlap import plan_stats
+
+            hooks.append(hooks_lib.OverlapHook(
+                writer,
+                plan_stats(state.params, mesh, rules, overlap_cfg)))
         from dist_mnist_tpu.faults.goodput import GoodputHook
 
         goodput_hook = GoodputHook(writer, every_steps=cfg.log_every)
@@ -625,6 +674,22 @@ def _apply_flag_overrides(cfg):
 
         resolve_remat_policy(FLAGS.remat_policy)
         over["remat_policy"] = FLAGS.remat_policy
+    if FLAGS.overlap is not None:
+        over["overlap"] = FLAGS.overlap
+    if FLAGS.overlap_bucket_mb is not None:
+        over["overlap_bucket_mb"] = FLAGS.overlap_bucket_mb
+    if FLAGS.overlap_chunk is not None:
+        over["overlap_chunk"] = FLAGS.overlap_chunk
+    if over.get("overlap", cfg.overlap) or FLAGS.overlap_chunk \
+            or FLAGS.overlap_bucket_mb is not None:
+        # validate EAGERLY (same rationale as sharding/remat_policy): a
+        # typo'd chunk mode or negative bucket must fail at flag-parse
+        # depth, not deep inside step construction
+        from dist_mnist_tpu.parallel.overlap import OverlapConfig
+
+        OverlapConfig(
+            bucket_mb=over.get("overlap_bucket_mb", cfg.overlap_bucket_mb),
+            chunk=over.get("overlap_chunk", cfg.overlap_chunk))
     return dataclasses.replace(cfg, **over) if over else cfg
 
 

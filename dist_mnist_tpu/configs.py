@@ -38,6 +38,15 @@ class Config:
     # column/row TP_RULES over the `model` axis) | "fsdp" (ZeRO-style:
     # params + optimizer slots sharded over `data`, 1/data-th per device)
     # | "fsdp_tp" (both composed) — parallel/sharding.py
+    overlap: bool = False  # fsdp comm/compute overlap: bucketed param
+    # all-gather prefetch + reduce-scatter flushed while the backward is
+    # still running (parallel/overlap.py). Requires an fsdp axis; value-
+    # identical to the serial path (bit-exact on the CPU mesh).
+    overlap_bucket_mb: float = 4.0  # bucket granularity: smaller buckets =
+    # more chunks in flight (better overlap, more launches); larger = fewer,
+    # bigger transfers
+    overlap_chunk: str = "all_gather"  # "all_gather" (one collective per
+    # leaf) | "ring" (ppermute double-buffering, collective_matmul-style)
     grad_clip_norm: float | None = None
     weight_decay: float = 0.0
     prng_impl: str = "threefry2x32"  # | "rbg": hardware-friendly PRNG —

@@ -23,6 +23,7 @@ from dist_mnist_tpu.hooks.builtin import (
     FinalOpsHook,
     MemoryProfileHook,
     MemoryHook,
+    OverlapHook,
 )
 
 __all__ = [
@@ -42,4 +43,5 @@ __all__ = [
     "FinalOpsHook",
     "MemoryProfileHook",
     "MemoryHook",
+    "OverlapHook",
 ]

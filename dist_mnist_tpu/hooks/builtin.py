@@ -544,6 +544,53 @@ class MemoryHook(Hook):
             self._emit(vals, step)
 
 
+class OverlapHook(Hook):
+    """Publishes the fsdp comm/compute-overlap plan (parallel/overlap.py
+    plan_stats) as `overlap/*` scalars at `begin` — the registry face of
+    `bench.py --overlap`. The plan is static for a run (pure metadata from
+    shard shapes), so one write at the initial step is the honest cadence:
+
+      overlap/buckets            all-gather flush groups in the plan
+      overlap/sharded_leaves     leaves actually gathered (fsdp-sharded)
+      overlap/total_leaves       all param leaves (context for the above)
+      overlap/gathered_bytes     unsharded bytes materialized per step
+      overlap/bucket_mb          configured bucket granularity
+      overlap/serial             1.0 = ablation twin (comm exposed on purpose)
+
+    `last` keeps the values for bench harnesses."""
+
+    def __init__(self, writer=None, stats: dict | None = None):
+        self._writer = writer
+        self._stats = dict(stats or {})
+        self.last: dict[str, float] = {}
+
+    def begin(self, loop):
+        vals = {}
+        for k, v in self._stats.items():
+            if isinstance(v, bool):
+                vals[f"overlap/{k}"] = 1.0 if v else 0.0
+            elif isinstance(v, (int, float)):
+                vals[f"overlap/{k}"] = v
+        log.info(
+            "fsdp overlap plan: %d buckets over %d sharded leaves "
+            "(%.2f MiB gathered per step, bucket_mb=%.1f, chunk=%s)",
+            self._stats.get("buckets", 0),
+            self._stats.get("sharded_leaves", 0),
+            self._stats.get("gathered_bytes", 0) / 2**20,
+            self._stats.get("bucket_mb", 0.0),
+            self._stats.get("chunk", "?"),
+        )
+        self.last.update(vals)
+        if self._writer is None:
+            return
+        batch_write = getattr(self._writer, "scalars", None)
+        if callable(batch_write):
+            batch_write(vals, loop.initial_step)
+        else:
+            for k, v in vals.items():
+                self._writer.scalar(k, v, loop.initial_step)
+
+
 class GlobalStepWaiterHook(Hook):
     """≙ GlobalStepWaiterHook (basic_session_run_hooks.py:902): delay this
     process's training until the job's global step reaches `wait_until_step`.

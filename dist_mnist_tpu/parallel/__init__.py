@@ -17,6 +17,9 @@ collective over ICI compiled into the step program.
 - `moe.py` — expert-parallel switch MoE (all_to_all dispatch).
 - `collective_matmul.py` — explicit overlapped AG->matmul / matmul->RS
   rings (the scaling-book TP idiom; GSPMD's automatic fusion is default).
+- `overlap.py` — fsdp comm/compute overlap: bucketed param all-gather
+  prefetch + reduce-scatter flushed during the backward, same latency-
+  hiding idiom applied to the ZeRO axis instead of the TP axis.
 - `ps_demo/` — native C++ demo of the reference's async-PS protocol.
 """
 
@@ -31,6 +34,12 @@ from dist_mnist_tpu.parallel.sharding import (
     params_sharding,
     tree_sharding,
 )
+from dist_mnist_tpu.parallel.overlap import (
+    OverlapConfig,
+    build_param_gather,
+    plan_stats,
+    prefetched_layer_matmul,
+)
 
 __all__ = [
     "ShardingRules",
@@ -42,4 +51,8 @@ __all__ = [
     "shard_train_state",
     "params_sharding",
     "tree_sharding",
+    "OverlapConfig",
+    "build_param_gather",
+    "plan_stats",
+    "prefetched_layer_matmul",
 ]

@@ -1,0 +1,324 @@
+"""Communication/compute overlap for the ZeRO/fsdp hot path.
+
+Under `fsdp` (parallel/sharding.py) every big param leaf lives 1/data-th
+per device; each step must all-gather params before use and reduce(-scatter)
+grads after the backward. GSPMD inserts those collectives wherever its
+scheduler likes — correct, but the SCHEDULE is implicit. This module makes
+the schedule an explicit, benchmarkable artifact, the ZeRO-axis sibling of
+parallel/collective_matmul.py's TP rings:
+
+- `build_param_gather` returns a value-level IDENTITY transform that
+  gathers the fsdp-sharded param leaves in BUCKETS (grouped by cumulative
+  bytes, in layer/traversal order) through explicit `compat_shard_map`
+  collectives — `lax.all_gather` per bucket, or the `ppermute` ring
+  decomposition (`chunk="ring"`) that rotates shards hop by hop exactly
+  like collective_matmul's rings. Its `jax.custom_vjp` backward pins each
+  bucket's grad cotangent to the fsdp sharding at the bucket boundary, so
+  early buckets' gradient reductions are already in flight while later
+  layers still run their backward (the bucketed flush).
+
+- `serial=True` builds the ABLATION TWIN: the same buckets chained through
+  `lax.optimization_barrier` so every gather strictly precedes compute and
+  every grad flush strictly follows the full backward — all communication
+  exposed on the critical path. `optimization_barrier` is a bit-exact
+  identity, so serial and overlapped trajectories are bit-identical BY
+  CONSTRUCTION, and both are bit-identical to plain GSPMD fsdp (all three
+  move the same values; only dependency edges differ). The serial twin is
+  what `bench.py --overlap` times against the overlapped program to report
+  `comm_exposed_ms_per_step` honestly.
+
+- `prefetched_layer_matmul` is the `lax.scan` double-buffering primitive
+  in executable-documentation form: a layer-stack matmul whose weights are
+  ZeRO-sharded over `data`, gathering layer l+1's shards WHILE layer l's
+  matmul runs (one-layer-ahead prefetch). The training models here keep
+  params as dicts rather than scanned stacks, so the train step buckets by
+  traversal order instead; this primitive is the stacked-layout shape of
+  the same schedule.
+
+Reference counterpart: none — the PS design (SURVEY.md §3.3) serialized
+all weight-pull/grad-push traffic by construction. Hot-path module: linted
+by scripts/check_host_sync.py (no host syncs may ride the prefetch path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import (
+    DATA_AXIS,
+    compat_axis_size,
+    compat_shard_map,
+)
+from dist_mnist_tpu.parallel.collectives import ring_shift
+from dist_mnist_tpu.parallel.sharding import ShardingRules, _paths
+
+#: gather decompositions: one `all_gather` op per leaf, or the explicit
+#: `ppermute` ring (n-1 `collective-permute` hops per leaf — the
+#: collective_matmul.py idiom on the ZeRO axis)
+CHUNK_MODES = ("all_gather", "ring")
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapConfig:
+    """Knobs of the explicit fsdp gather/flush schedule.
+
+    `bucket_mb` trades latency for pipelining: a bucket's gather is one
+    collective launch, so tiny buckets pay launch overhead per layer while
+    one huge bucket degenerates to gather-everything-up-front (no overlap
+    left to find). `serial=True` is the barriered ablation twin — never a
+    production setting, it exists so the overlap win is measurable as a
+    controlled pair. Every field is folded into the compile-cache key
+    (cli/train.py) — cached executables never mix schedules."""
+
+    bucket_mb: float = 4.0
+    chunk: str = "all_gather"  # | "ring"
+    serial: bool = False  # True = barriered ablation twin (comm exposed)
+
+    def __post_init__(self):
+        if self.chunk not in CHUNK_MODES:
+            raise ValueError(
+                f"unknown overlap chunk mode {self.chunk!r}; use one of "
+                f"{CHUNK_MODES}"
+            )
+        if not self.bucket_mb > 0:
+            raise ValueError(f"bucket_mb must be > 0, got {self.bucket_mb}")
+
+
+def _nbytes(leaf) -> int:
+    shape = getattr(leaf, "shape", ())
+    itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+    return math.prod(shape) * itemsize
+
+
+def _plan(params, mesh: Mesh, rules: ShardingRules, cfg: OverlapConfig):
+    """(treedef, leaves, specs, dims, buckets) for `params` under `rules`.
+
+    `dims[i]` is the dim of leaf i that the fsdp axis shards (None when the
+    leaf is not fsdp-sharded — small biases, counters — and passes through
+    untouched). Buckets are index groups of SHARDED leaves in traversal
+    order (= layer order for the dict models here), closed when cumulative
+    global bytes reach `bucket_mb` — the leaf that crosses the threshold
+    closes its bucket."""
+    axis = rules.fsdp_axis
+    flat, treedef, paths = _paths(params)
+    leaves = [v for _, v in flat]
+    specs = [rules.leaf_spec(p, v, mesh) for p, v in zip(paths, leaves)]
+    dims = []
+    for s in specs:
+        entries = tuple(s)
+        dims.append(entries.index(axis) if axis in entries else None)
+    limit = max(1, int(cfg.bucket_mb * 2**20))
+    buckets, cur, cur_bytes = [], [], 0
+    for i, d in enumerate(dims):
+        if d is None:
+            continue
+        cur.append(i)
+        cur_bytes += _nbytes(leaves[i])
+        if cur_bytes >= limit:
+            buckets.append(tuple(cur))
+            cur, cur_bytes = [], 0
+    if cur:
+        buckets.append(tuple(cur))
+    return treedef, leaves, specs, dims, buckets
+
+
+def plan_stats(params, mesh: Mesh, rules: ShardingRules,
+               cfg: OverlapConfig) -> dict:
+    """Pure-metadata description of the gather plan for `params` — what
+    OverlapHook publishes as `overlap/*` scalars and bench reports. No
+    transfer, no trace."""
+    _, leaves, _, dims, buckets = _plan(params, mesh, rules, cfg)
+    sharded = [i for i, d in enumerate(dims) if d is not None]
+    return {
+        "buckets": len(buckets),
+        "sharded_leaves": len(sharded),
+        "total_leaves": len(leaves),
+        "gathered_bytes": sum(_nbytes(leaves[i]) for i in sharded),
+        "bucket_mb": cfg.bucket_mb,
+        "serial": cfg.serial,
+        "chunk": cfg.chunk,
+    }
+
+
+def _ring_gather(loc, axis_name: str, d: int):
+    """all_gather via explicit ppermute hops (collective_matmul.py's ring,
+    gather-only): rotate the local shard around the ring, depositing each
+    arriving shard into its block of the full array. Pure copies — bit-exact
+    — and each hop is independent of the previous deposit, so the scheduler
+    may overlap hops with whatever compute is ready."""
+    n = compat_axis_size(axis_name)
+    i0 = lax.axis_index(axis_name)
+    m = loc.shape[d]
+    full_shape = loc.shape[:d] + (n * m,) + loc.shape[d + 1:]
+    out = jnp.zeros(full_shape, loc.dtype)
+    buf = loc
+    for k in range(n):
+        # buf holds shard (i0 + k) % n — same rotation bookkeeping as
+        # allgather_matmul (parallel/collective_matmul.py)
+        block = (i0 + k) % n
+        start = (0,) * d + (block * m,) + (0,) * (loc.ndim - d - 1)
+        out = lax.dynamic_update_slice(out, buf, start)
+        if k < n - 1:
+            buf = ring_shift(buf, axis_name, reverse=True)
+    return out
+
+
+def _bucket_gather_fn(mesh: Mesh, axis: str, in_specs, out_specs, dims,
+                      chunk: str):
+    """One shard_map gathering a whole bucket: local fsdp shards in, full
+    (data-replicated) leaves out. One collective launch region per bucket —
+    the granularity the scheduler overlaps."""
+
+    def body(*locs):
+        outs = []
+        for loc, d in zip(locs, dims):
+            if chunk == "ring":
+                outs.append(_ring_gather(loc, axis, d))
+            else:
+                outs.append(lax.all_gather(loc, axis, axis=d, tiled=True))
+        return tuple(outs)
+
+    return compat_shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                            out_specs=tuple(out_specs))
+
+
+def build_param_gather(mesh: Mesh, rules: ShardingRules, cfg: OverlapConfig):
+    """`gather(params) -> params` — the explicit fsdp gather boundary.
+
+    Value-level identity: fsdp-sharded leaves come back gathered (data axis
+    removed from their layout), everything else passes through untouched.
+    Apply INSIDE the loss (under `value_and_grad`) so the custom backward
+    owns the gradient flush: each bucket's cotangent is pinned to the fsdp
+    sharding at the bucket boundary (`with_sharding_constraint`), which is
+    where GSPMD materializes the cross-data reduction — reduce-scatter when
+    the backend fuses it, all-reduce-then-slice otherwise. `cfg.serial`
+    chains both directions through `optimization_barrier` (see module
+    docstring)."""
+    if rules.fsdp_axis is None:
+        raise ValueError(
+            "communication/compute overlap needs an fsdp strategy: the "
+            f"active sharding rules {rules.rules or '(dp)'} have no "
+            "fsdp_axis, so there are no parameter shards to prefetch. "
+            "Use sharding_rules='fsdp' or 'fsdp_tp'."
+        )
+    axis = rules.fsdp_axis
+
+    def gather(params):
+        treedef, leaves, specs, dims, buckets = _plan(params, mesh, rules,
+                                                      cfg)
+        if not buckets:
+            return params
+        out_specs = [
+            P(*(None if e == axis else e for e in tuple(s))) for s in specs
+        ]
+        bucket_fns = [
+            _bucket_gather_fn(
+                mesh, axis,
+                [specs[i] for i in b], [out_specs[i] for i in b],
+                [dims[i] for i in b], cfg.chunk,
+            )
+            for b in buckets
+        ]
+
+        @jax.custom_vjp
+        def gathered(*shd):
+            ls = list(shd)
+            prev = None
+            for b, fn in zip(buckets, bucket_fns):
+                ins = [ls[i] for i in b]
+                if cfg.serial and prev is not None:
+                    # serialize: bucket k+1's gather may not issue until
+                    # bucket k's has produced a value
+                    tied = lax.optimization_barrier(tuple(ins) + (prev,))
+                    ins = list(tied[:-1])
+                outs = fn(*ins)
+                for j, i in enumerate(b):
+                    ls[i] = outs[j]
+                prev = outs[0]
+            if cfg.serial:
+                # expose ALL gather time: no compute may start before the
+                # last bucket lands (identity — bit-exact)
+                ls = list(lax.optimization_barrier(tuple(ls)))
+            return tuple(ls)
+
+        def fwd(*shd):
+            return gathered(*shd), None
+
+        def bwd(_, cts):
+            cts = list(cts)
+            prev = None
+            order = list(reversed(buckets)) if cfg.serial else buckets
+            for b in order:
+                grp = tuple(cts[i] for i in b)
+                if cfg.serial and prev is not None:
+                    # serialize flushes back-to-front, after ALL backward
+                    # compute (each ct is only ready once its layer's
+                    # backward ran; the chain then orders the reductions)
+                    tied = lax.optimization_barrier(grp + (prev,))
+                    grp = tied[:-1]
+                else:
+                    # bucketed flush: the bucket's cotangents leave as one
+                    # group, so its reductions launch together while later
+                    # (earlier-layer) backward is still computing
+                    grp = lax.optimization_barrier(grp)
+                for j, i in enumerate(b):
+                    cts[i] = lax.with_sharding_constraint(
+                        grp[j], NamedSharding(mesh, specs[i])
+                    )
+                prev = cts[b[-1]]
+            return tuple(cts)
+
+        gathered.defvjp(fwd, bwd)
+        return jax.tree.unflatten(treedef, list(gathered(*leaves)))
+
+    return gather
+
+
+def prefetched_layer_matmul(x, ws, mesh: Mesh, axis: str = DATA_AXIS,
+                            activation=jnp.tanh):
+    """Layer-stack matmul with one-layer-ahead weight prefetch under
+    `lax.scan` — the double-buffered form of the train step's bucket
+    schedule, for models that keep weights as a scanned stack.
+
+    x:  [B, D] batch-sharded over `axis` (rows).
+    ws: [L, D, D] with dim 1 (each layer's input dim) sharded over `axis` —
+        the ZeRO resident layout: every device holds [L, D/n, D].
+    Applies `h = activation(h @ W_l)` for l = 0..L-1. The scan carry is
+    (activations, CURRENT full weight); each iteration all-gathers layer
+    l+1's shards — independent of layer l's matmul, so the gather rides
+    alongside it — and double-buffers the result into the carry. Returns
+    [B, D] sharded over `axis`, bit-identical to the serial gather-then-
+    matmul loop (gathers are pure copies)."""
+    n = mesh.shape[axis]
+    if ws.ndim != 3 or ws.shape[0] < 1:
+        raise ValueError(f"ws must be a [L, D, D] layer stack, got {ws.shape}")
+    if ws.shape[1] % n or x.shape[0] % n:
+        raise ValueError(
+            f"D={ws.shape[1]} and B={x.shape[0]} must divide {axis}={n}"
+        )
+
+    def body(x_local, ws_local):
+        def gather_w(w_shard):  # [D/n, D] -> [D, D]
+            return lax.all_gather(w_shard, axis, axis=0, tiled=True)
+
+        def step(carry, w_next_shard):
+            h, w_cur = carry
+            w_next = gather_w(w_next_shard)  # prefetch: no dep on the dot
+            h = activation(h @ w_cur)
+            return (h, w_next), None
+
+        (h, w_last), _ = lax.scan(step, (x_local, gather_w(ws_local[0])),
+                                  ws_local[1:])
+        return activation(h @ w_last)
+
+    return compat_shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(None, axis, None)),
+        out_specs=P(axis, None),
+    )(x, ws)

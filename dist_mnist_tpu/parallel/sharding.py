@@ -242,6 +242,30 @@ def params_sharding(params, mesh: Mesh, rules: ShardingRules = DP_RULES):
     return tree_sharding(params, mesh, rules)
 
 
+def _put_via_callback(leaf, sharding):
+    """Place one (host-resident, process-identical) leaf under `sharding`
+    without any cross-process collective.
+
+    `jax.device_put` onto a sharding that is not fully addressable first
+    broadcast-verifies the value across processes (multihost_utils.
+    assert_equal) — one gloo broadcast PER LEAF, which flakes under
+    concurrent launch (`op.preamble.length <= op.nbytes`). Initial state
+    is computed identically on every process (same seed, same pure
+    program), so the check is redundant: assemble the global array from
+    local slices directly. Bitwise-equal to the device_put result.
+
+    A leaf that is already a global (non-addressable) jax.Array cannot be
+    read host-side; those fall back to device_put — by then they already
+    carry a committed sharding, so no equality broadcast fires."""
+    import numpy as np
+
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        return jax.device_put(leaf, sharding)
+    arr = np.asarray(leaf)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def shard_train_state(state, mesh: Mesh, rules: ShardingRules = DP_RULES):
     """Device_put a TrainState with params/opt-state placed by `rules`.
 
@@ -267,4 +291,16 @@ def shard_train_state(state, mesh: Mesh, rules: ShardingRules = DP_RULES):
             "match this model's params, or use DP_RULES explicitly."
         )
     sharded = tree_sharding(state, mesh, rules)
-    return jax.device_put(state, sharded)
+    if all(s.is_fully_addressable
+           for s in jax.tree.leaves(sharded,
+                                    is_leaf=lambda x: isinstance(
+                                        x, NamedSharding))):
+        return jax.device_put(state, sharded)
+    # Multi-process: jax.device_put on a non-fully-addressable sharding
+    # routes through multihost_utils.assert_equal — a per-leaf gloo
+    # broadcast that races when many leaves go out back-to-back
+    # (`op.preamble.length <= op.nbytes` SIGABRT). Every process computes
+    # the SAME deterministic init here (same seed, same program), so the
+    # cross-host equality check buys nothing: build each global array
+    # directly from the local copy instead, no collective at all.
+    return jax.tree.map(_put_via_callback, state, sharded)

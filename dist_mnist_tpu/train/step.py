@@ -80,7 +80,7 @@ def model_aux_loss(model_state):
 def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
                 dropout_key, *, with_grad_norm: bool = False,
                 remat: bool = False, augment: bool = False,
-                remat_policy: str = "dots_no_batch"):
+                remat_policy: str = "dots_no_batch", param_gather=None):
     """The shared fwd+bwd+update body every step variant compiles.
 
     `remat=True` wraps the forward in `jax.checkpoint`: activations are
@@ -89,6 +89,13 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
     on long token sequences). `remat_policy` selects WHAT is saved vs
     recomputed (REMAT_POLICIES above); the default recomputes the batched
     attention dots, `save_attn` keeps them.
+
+    `param_gather` (parallel/overlap.build_param_gather) is the explicit
+    fsdp gather boundary: a value-level identity that bucket-gathers the
+    sharded params ahead of use and flushes grad reduce-scatters per bucket
+    in its custom backward. It must run INSIDE the loss closure — under
+    `value_and_grad` — so the backward owns the flush schedule; None keeps
+    GSPMD's implicit gather-on-use (bit-identical either way).
     """
     # Structural guards (SURVEY.md §5.2): trace-time only — zero runtime
     # cost under jit. The reference's analogue was graph finalization +
@@ -119,6 +126,8 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
         )
 
     def loss_of(params):
+        if param_gather is not None:
+            params = param_gather(params)
         logits, new_model_state = forward(params, state.model_state, x)
         loss = loss_fn(logits, y)
         # auxiliary objectives the model emits ride in model_state and
@@ -166,7 +175,7 @@ def _train_core(model, optimizer, loss_fn, state: TrainState, batch,
 
 def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
                     remat: bool = False, augment: bool = False,
-                    remat_policy: str = "dots_no_batch"):
+                    remat_policy: str = "dots_no_batch", param_gather=None):
     """One step with batch sampling inside the program (fused-input body).
     The resident dataset arrays arrive as EXPLICIT args (`data`), never as
     closed-over constants — a multi-process global array may not be
@@ -181,7 +190,8 @@ def _fused_one_step(model, optimizer, loss_fn, device_dataset, batch_size,
                                              images, labels)
         return _train_core(model, optimizer, loss_fn, state, batch,
                            dropout_key, remat=remat, augment=augment,
-                           remat_policy=remat_policy)
+                           remat_policy=remat_policy,
+                           param_gather=param_gather)
 
     return one_step
 
@@ -316,6 +326,17 @@ def _lazy_jit(step, mesh, rules, donate, n_args=1, bound_data=None,
     return wrapper
 
 
+def _overlap_gather(mesh, rules, overlap):
+    """OverlapConfig -> param-gather callable (None passes through).
+    Validation (overlap needs an fsdp rule set) happens HERE, at step-build
+    time — before any compile or data work."""
+    if overlap is None:
+        return None
+    from dist_mnist_tpu.parallel.overlap import build_param_gather
+
+    return build_param_gather(mesh, rules, overlap)
+
+
 def make_train_step(
     model,
     optimizer: Optimizer,
@@ -328,6 +349,7 @@ def make_train_step(
     remat: bool = False,
     augment: bool = False,
     remat_policy: str = "dots_no_batch",
+    overlap=None,
     store=None,
     cache_key: str | None = None,
 ):
@@ -338,16 +360,21 @@ def make_train_step(
       mutable PS variables, without the mutation).
     - batch["image"] is uint8 NHWC sharded on `data`; normalization to
       [0,1] f32 runs on-device post-shard (4x less host->device traffic).
+    - `overlap` (parallel/overlap.OverlapConfig): explicit bucketed fsdp
+      param-gather/grad-flush schedule (needs `rules` with an fsdp_axis);
+      None = GSPMD's implicit gather-on-use. Bit-identical trajectories
+      either way.
     - `store`/`cache_key` (compilecache/): warm-start from a serialized
       AOT executable when a prior process saved one under this key.
     """
+    gather = _overlap_gather(mesh, rules, overlap)
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
         dropout_key = jax.random.fold_in(state.rng, state.step)
         return _train_core(model, optimizer, loss_fn, state, batch,
                            dropout_key, with_grad_norm=with_grad_norm,
                            remat=remat, augment=augment,
-                           remat_policy=remat_policy)
+                           remat_policy=remat_policy, param_gather=gather)
 
     return _lazy_jit(step, mesh, rules, donate, n_args=2,
                      store=store, key=cache_key)
@@ -365,6 +392,7 @@ def make_fused_train_step(
     remat: bool = False,
     augment: bool = False,
     remat_policy: str = "dots_no_batch",
+    overlap=None,
     store=None,
     cache_key: str | None = None,
 ):
@@ -376,7 +404,9 @@ def make_fused_train_step(
     loop's shuffled epochs)."""
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
                                batch_size, remat=remat, augment=augment,
-                               remat_policy=remat_policy)
+                               remat_policy=remat_policy,
+                               param_gather=_overlap_gather(mesh, rules,
+                                                            overlap))
     return _lazy_jit(one_step, mesh, rules, donate=True,
                      bound_data=device_dataset.arrays,
                      store=store, key=cache_key)
@@ -395,6 +425,7 @@ def make_scanned_train_fn(
     remat: bool = False,
     augment: bool = False,
     remat_policy: str = "dots_no_batch",
+    overlap=None,
     store=None,
     cache_key: str | None = None,
 ):
@@ -407,7 +438,9 @@ def make_scanned_train_fn(
 
     one_step = _fused_one_step(model, optimizer, loss_fn, device_dataset,
                                batch_size, remat=remat, augment=augment,
-                               remat_policy=remat_policy)
+                               remat_policy=remat_policy,
+                               param_gather=_overlap_gather(mesh, rules,
+                                                            overlap))
 
     def run_chunk(state: TrainState, data):
         state, outs = jax.lax.scan(

@@ -56,7 +56,10 @@ def default_targets(repo_root: Path) -> list[Path]:
     # faults/ sits inside the loop (injection hook per step, goodput clock
     # per iteration) — same hot-path rules apply
     targets += sorted((pkg / "faults").glob("*.py"))
-    targets += [pkg / "data" / "prefetch.py", pkg / "hooks" / "builtin.py"]
+    # parallel/overlap.py builds the comm/compute-overlap prefetch path —
+    # one host sync there serializes exactly what it exists to overlap
+    targets += [pkg / "data" / "prefetch.py", pkg / "hooks" / "builtin.py",
+                pkg / "parallel" / "overlap.py"]
     return [t for t in targets if t.exists()]
 
 

@@ -176,15 +176,49 @@ def main():
         )
         time_full("full_nodropout", model_nd, state_nd)
 
+        # --- fsdp comm exposure: the same fused step under ZeRO sharding,
+        # serial (barriered — all gathers/flushes on the critical path) vs
+        # overlapped (parallel/overlap.py bucket schedule). The delta is
+        # the communication the overlap removed. Needs >1 chip on `data`.
+        if mesh.shape["data"] > 1:
+            from dist_mnist_tpu.parallel.overlap import OverlapConfig
+            from dist_mnist_tpu.parallel.sharding import FSDP_RULES
+
+            for name, serial in (("fsdp_serial", True),
+                                 ("fsdp_overlap", False)):
+                # fresh state per variant: the scanned step donates its
+                # input buffers, so one state cannot feed two timed runs
+                state_f = shard_train_state(
+                    create_train_state(model, optimizer,
+                                       jax.random.PRNGKey(0),
+                                       ds.train_images[:1]),
+                    mesh, FSDP_RULES,
+                )
+                run = make_scanned_train_fn(
+                    model, optimizer, mesh, dd, args.batch, args.chunk,
+                    rules=FSDP_RULES,
+                    overlap=OverlapConfig(serial=serial))
+                dt, _, _ = timed_chunks(run, state_f, args.chunks)
+                emit(name, dt / (args.chunk * args.chunks))
+        else:
+            print(json.dumps({"variant": "fsdp_serial",
+                              "skipped": "single-chip mesh: no fsdp "
+                                         "communication to attribute"}),
+                  flush=True)
+
     d = {k: v * 1e6 for k, v in results.items()}
-    print(json.dumps({"attribution_us": {
+    attribution = {
         "forward": round(d["fwd"], 1),
         "backward": round(d["fwd_bwd"] - d["fwd"], 1),
         "optimizer": round(d["fwd_bwd_adam"] - d["fwd_bwd"], 1),
         "sampling+metrics": round(d["full"] - d["fwd_bwd_adam"], 1),
         "dropout_only": round(d["full"] - d["full_nodropout"], 1),
         "full_step": round(d["full"], 1),
-    }}))
+    }
+    if "fsdp_serial" in d:
+        attribution["fsdp_comm_exposed"] = round(
+            d["fsdp_serial"] - d["fsdp_overlap"], 1)
+    print(json.dumps({"attribution_us": attribution}))
 
 
 if __name__ == "__main__":

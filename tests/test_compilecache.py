@@ -53,11 +53,40 @@ def test_cache_key_stable():
     {"dtype": "bfloat16"},                   # dtype
     {"donate": False},                       # donation
     {"scan_chunk": 100},                     # scan chunk
+    {"overlap": True},                       # comm/compute overlap schedule
+    {"overlap_bucket_mb": 8.0},              # overlap bucket granularity
+    {"overlap_chunk": "ring"},               # overlap gather decomposition
     {"jax_version": "0.0.0-stale"},          # runtime version (implicit field)
     {"backend": "tpu"},                      # backend (implicit field)
 ])
 def test_cache_key_invalidates(change):
     assert cache_key({**BASE_FIELDS, **change}) != cache_key(BASE_FIELDS)
+
+
+@pytest.mark.parametrize("override", [
+    {"overlap": True},
+    {"overlap_bucket_mb": 0.5},
+    {"overlap_chunk": "ring"},
+])
+def test_compile_cache_key_fields_cover_overlap_knobs(mesh8, override):
+    """The driver's key-field builder must fold every overlap knob in, so
+    toggling --overlap (or its sub-knobs) forces a store MISS instead of
+    loading a stale serial executable — the schedules lower to different
+    HLO even though they are value-identical."""
+    import dataclasses
+
+    from dist_mnist_tpu.cli.train import compile_cache_key_fields
+    from dist_mnist_tpu.configs import get_config
+
+    cfg = get_config("lenet5_fashion")
+    base = compile_cache_key_fields(cfg, mesh8)
+    changed = compile_cache_key_fields(
+        dataclasses.replace(cfg, **override), mesh8)
+    assert cache_key({"kind": "train", **base}) != \
+        cache_key({"kind": "train", **changed})
+    # and the store behaves accordingly: a key derived from the overlapped
+    # config cannot hit an entry saved under the serial config's key
+    assert base != changed
 
 
 # -- ExecutableStore round trip ----------------------------------------------

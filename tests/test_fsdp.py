@@ -78,6 +78,29 @@ def test_opt_state_inherits_specs_through_chain_and_accumulation(mesh8):
             assert spec == P(), (path, spec)
 
 
+def test_put_via_callback_matches_device_put(mesh8):
+    """The multi-process placement path (shard_train_state's no-broadcast
+    alternative to device_put — the gloo `op.preamble.length <= op.nbytes`
+    flake fix) must be bitwise-equal to device_put, leaf by leaf, with the
+    same shardings — including the uint32 rng key and the scalar step."""
+    from dist_mnist_tpu.parallel.sharding import (
+        _put_via_callback,
+        tree_sharding,
+    )
+
+    model = get_model("mlp", hidden_units=64)
+    opt = optim.adam(1e-3)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 28, 28, 1), jnp.uint8))
+    shardings = tree_sharding(state, mesh8, FSDP_RULES)
+    via_put = jax.device_put(state, shardings)
+    via_cb = jax.tree.map(_put_via_callback, state, shardings)
+    for a, b in zip(jax.tree.leaves(via_put), jax.tree.leaves(via_cb)):
+        assert a.sharding == b.sharding
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        assert a.dtype == b.dtype
+
+
 def test_shard_train_state_places_opt_state_sharded(mesh8):
     _, _, state = _mlp_state(mesh8, FSDP_RULES)
     assert state.params["hid"]["w"].sharding.spec == P("data", None)

@@ -1,0 +1,279 @@
+"""Communication/compute overlap for the fsdp hot path (ISSUE 7).
+
+Acceptance contract: the overlapped bucket schedule (parallel/overlap.py)
+is a value-level IDENTITY — its loss trajectory over >= 2 epochs on the
+8-device CPU mesh is BIT-identical to the barriered serial twin, to plain
+GSPMD fsdp, and (ring mode) to the ppermute decomposition; the compiled
+program emits its collectives in chunked (per-bucket) form; the schedule
+composes with TP (`fsdp_tp`); plan/bucket metadata is exact; and the
+driver refuses overlap under a rule set with nothing to gather.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dist_mnist_tpu import optim
+from dist_mnist_tpu.cluster.mesh import DATA_AXIS
+from dist_mnist_tpu.data.pipeline import ShardedBatcher, shard_batch
+from dist_mnist_tpu.models import get_model
+from dist_mnist_tpu.parallel.overlap import (
+    OverlapConfig,
+    build_param_gather,
+    plan_stats,
+    prefetched_layer_matmul,
+)
+from dist_mnist_tpu.parallel.sharding import (
+    DP_RULES,
+    FSDP_RULES,
+    FSDP_TP_RULES,
+    shard_train_state,
+)
+from dist_mnist_tpu.train import create_train_state
+from dist_mnist_tpu.train.step import make_train_step
+
+#: tiny bucket -> every sharded leaf closes its own bucket; the MLP has two
+#: fsdp-sharded matrices, so chunked structure is visible with 2+ buckets
+TINY_BUCKET = 1e-6
+
+VARIANTS = {
+    "gspmd": None,  # implicit gather-on-use — the PR 3 baseline
+    "serial": OverlapConfig(bucket_mb=TINY_BUCKET, serial=True),
+    "overlap": OverlapConfig(bucket_mb=TINY_BUCKET),
+    "ring": OverlapConfig(bucket_mb=TINY_BUCKET, chunk="ring"),
+}
+
+
+def _mlp_state(mesh, rules, hidden=64):
+    model = get_model("mlp", hidden_units=hidden)
+    opt = optim.adam(1e-3)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 28, 28, 1), jnp.uint8))
+    return model, opt, shard_train_state(state, mesh, rules)
+
+
+# ------------------------------------------------------------- trajectory --
+
+
+def test_overlap_trajectories_bit_identical_two_epochs(mesh8, small_mnist):
+    """Same seed, same stream, two full epochs: gathers are copies and
+    optimization_barrier is a bit-exact identity, so ALL schedules —
+    GSPMD, barriered serial, overlapped buckets, ppermute ring — must
+    produce the SAME bits, not just close floats. An overlap 'win' that
+    perturbed the math would be a different optimizer."""
+    batch_size = 512
+    n_steps = 2 * (len(small_mnist.train_labels) // batch_size)
+    assert n_steps >= 8
+    traj = {}
+    for name, overlap in VARIANTS.items():
+        model, opt, state = _mlp_state(mesh8, FSDP_RULES)
+        step = make_train_step(model, opt, mesh8, rules=FSDP_RULES,
+                               overlap=overlap)
+        batches = iter(ShardedBatcher(small_mnist, batch_size, mesh8, seed=0))
+        losses = []
+        for _ in range(n_steps):
+            state, out = step(state, next(batches))
+            losses.append(out["loss"])
+        traj[name] = np.asarray(jax.device_get(losses), np.float64)
+    for name in ("serial", "overlap", "ring"):
+        np.testing.assert_array_equal(
+            traj[name], traj["gspmd"],
+            err_msg=f"{name} diverged from gspmd fsdp")
+    assert traj["gspmd"][-1] < traj["gspmd"][0]  # it actually trained
+
+
+def test_overlap_composes_with_tp(mesh_tp, small_mnist):
+    """fsdp_tp: the gather plan must leave TP-sharded dims alone (only the
+    fsdp axis is removed from the output layout), and the overlapped
+    trajectory must stay bit-identical to GSPMD under the composed rules."""
+    batch_size = 512
+    traj = {}
+    for name, overlap in (("gspmd", None),
+                          ("overlap", OverlapConfig(bucket_mb=TINY_BUCKET))):
+        model, opt, state = _mlp_state(mesh_tp, FSDP_TP_RULES)
+        step = make_train_step(model, opt, mesh_tp, rules=FSDP_TP_RULES,
+                               overlap=overlap)
+        batches = iter(ShardedBatcher(small_mnist, batch_size, mesh_tp,
+                                      seed=0))
+        losses = []
+        for _ in range(6):
+            state, out = step(state, next(batches))
+            losses.append(out["loss"])
+        traj[name] = np.asarray(jax.device_get(losses), np.float64)
+    np.testing.assert_array_equal(traj["overlap"], traj["gspmd"])
+
+
+# ------------------------------------------------------------ collectives --
+
+
+def _compiled_text(mesh, overlap, batch=64):
+    model, opt, state = _mlp_state(mesh, FSDP_RULES)
+    step = make_train_step(model, opt, mesh, rules=FSDP_RULES, donate=False,
+                           overlap=overlap)
+    img = np.zeros((batch, 28, 28, 1), np.uint8)
+    lab = np.zeros((batch,), np.int32)
+    return step.compiled_text(state, shard_batch(
+        {"image": img, "label": lab}, mesh))
+
+
+def test_overlap_hlo_emits_chunked_collectives(mesh8):
+    """The overlapped program must keep its collectives in CHUNKED form:
+    at least one gather collective per bucket (the bucket boundary is a
+    shard_map region GSPMD cannot merge away) plus a collective gradient
+    reduction. Per-bucket granularity is what the scheduler overlaps."""
+    cfg = OverlapConfig(bucket_mb=TINY_BUCKET)
+    text = _compiled_text(mesh8, cfg)
+    if text is None:
+        pytest.skip("backend cannot render compiled HLO text")
+    model, opt, state = _mlp_state(mesh8, FSDP_RULES)
+    stats = plan_stats(state.params, mesh8, FSDP_RULES, cfg)
+    assert stats["buckets"] >= 2  # tiny bucket => one bucket per matrix
+    assert text.count("all-gather(") >= stats["buckets"]
+    assert ("all-reduce(" in text) or ("reduce-scatter(" in text)
+
+
+def test_ring_hlo_uses_collective_permute(mesh8):
+    """chunk='ring' decomposes every gather into ppermute hops — the
+    compiled program must carry collective-permutes and NO all-gather
+    (n-1 hops per leaf, like parallel/collective_matmul.py's rings)."""
+    text = _compiled_text(mesh8, OverlapConfig(bucket_mb=TINY_BUCKET,
+                                               chunk="ring"))
+    if text is None:
+        pytest.skip("backend cannot render compiled HLO text")
+    assert text.count("collective-permute(") > 0
+    assert "all-gather(" not in text
+
+
+# ------------------------------------------------------------------- plan --
+
+
+def test_plan_stats_bucket_grouping(mesh8):
+    _, _, state = _mlp_state(mesh8, FSDP_RULES)
+    tiny = plan_stats(state.params, mesh8, FSDP_RULES,
+                      OverlapConfig(bucket_mb=TINY_BUCKET))
+    huge = plan_stats(state.params, mesh8, FSDP_RULES,
+                      OverlapConfig(bucket_mb=1e3))
+    # mlp-64: hid/w (784,64), hid/b (64,), and sm/w (64,10) all have a dim
+    # divisible by 8, so the shape rule shards them; sm/b (10,) does not
+    assert tiny["sharded_leaves"] == 3
+    assert tiny["total_leaves"] == 4
+    assert tiny["buckets"] == 3       # every sharded leaf closes a bucket
+    assert huge["buckets"] == 1       # nothing reaches the threshold
+    assert huge["sharded_leaves"] == 3
+    gathered = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in (state.params["hid"]["w"], state.params["hid"]["b"],
+                     state.params["sm"]["w"]))
+    assert tiny["gathered_bytes"] == huge["gathered_bytes"] == gathered
+
+
+def test_gather_is_identity_with_gathered_layout(mesh8):
+    """build_param_gather under jit: values unchanged bitwise, fsdp leaves
+    come out with the data axis REMOVED from their spec, non-sharded
+    leaves pass through."""
+    _, _, state = _mlp_state(mesh8, FSDP_RULES)
+    gather = build_param_gather(mesh8, FSDP_RULES, OverlapConfig())
+
+    out = jax.jit(gather)(state.params)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(state.params),
+            jax.tree_util.tree_leaves_with_path(out)):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # sharded matrix now replicated over data; bias spec untouched
+    assert out["hid"]["w"].sharding.is_equivalent_to(
+        NamedSharding(mesh8, P(None, None)), 2)
+
+
+def test_overlap_requires_fsdp_rules(mesh8):
+    with pytest.raises(ValueError, match="fsdp"):
+        build_param_gather(mesh8, DP_RULES, OverlapConfig())
+
+
+@pytest.mark.parametrize("bad", [
+    {"chunk": "rinng"},
+    {"bucket_mb": 0.0},
+    {"bucket_mb": -1.0},
+])
+def test_overlap_config_validates(bad):
+    with pytest.raises(ValueError):
+        OverlapConfig(**bad)
+
+
+def test_cli_rejects_overlap_without_fsdp(mesh8):
+    """--overlap on a dp config must fail eagerly with a pointed message,
+    not silently train unoverlapped (the resolve_rules precedent)."""
+    from dist_mnist_tpu.cli.train import run_config
+    from dist_mnist_tpu.configs import get_config
+
+    cfg = dataclasses.replace(get_config("lenet5_fashion"), overlap=True,
+                              train_steps=1, eval_every=0)
+    assert cfg.sharding_rules == "dp"
+    with pytest.raises(ValueError, match="fsdp"):
+        run_config(cfg, data_dir="/definitely-not-a-dir", mesh=mesh8)
+
+
+# -------------------------------------------------------------- primitive --
+
+
+def test_prefetched_layer_matmul_matches_serial(mesh8):
+    """The lax.scan double-buffered layer stack equals the plain serial
+    gather-then-matmul loop bitwise (gathers are copies)."""
+    L, B, D = 4, 16, 32
+    key = jax.random.PRNGKey(3)
+    x = jax.random.normal(key, (B, D), jnp.float32)
+    ws = jax.random.normal(jax.random.fold_in(key, 1), (L, D, D),
+                           jnp.float32) / np.sqrt(D)
+    x_s = jax.device_put(x, NamedSharding(mesh8, P(DATA_AXIS, None)))
+    ws_s = jax.device_put(ws, NamedSharding(mesh8, P(None, DATA_AXIS, None)))
+
+    got = prefetched_layer_matmul(x_s, ws_s, mesh8)
+    want = x
+    for l in range(L):
+        want = jnp.tanh(want @ ws[l])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert got.sharding.is_equivalent_to(
+        NamedSharding(mesh8, P(DATA_AXIS, None)), 2)
+
+
+@pytest.mark.parametrize("bad_shape", [(32, 32), (2, 30, 30)])
+def test_prefetched_layer_matmul_validates(mesh8, bad_shape):
+    x = jnp.zeros((16, bad_shape[-1]), jnp.float32)
+    with pytest.raises(ValueError):
+        prefetched_layer_matmul(x, jnp.zeros(bad_shape, jnp.float32), mesh8)
+
+
+# ------------------------------------------------------------------- hook --
+
+
+def test_overlap_hook_publishes_numeric_plan(mesh8):
+    from dist_mnist_tpu.hooks import OverlapHook
+
+    class _Writer:
+        def __init__(self):
+            self.rows = []
+
+        def scalars(self, vals, step):
+            self.rows.append((dict(vals), step))
+
+    class _Loop:
+        initial_step = 0
+
+    _, _, state = _mlp_state(mesh8, FSDP_RULES)
+    stats = plan_stats(state.params, mesh8, FSDP_RULES,
+                       OverlapConfig(bucket_mb=TINY_BUCKET, serial=True))
+    writer = _Writer()
+    hook = OverlapHook(writer, stats)
+    hook.begin(_Loop())
+    (vals, step), = writer.rows
+    assert step == 0
+    assert vals["overlap/buckets"] == stats["buckets"]
+    assert vals["overlap/gathered_bytes"] == stats["gathered_bytes"]
+    assert vals["overlap/serial"] == 1.0
+    assert "overlap/chunk" not in vals  # strings never become scalars
+    assert all(isinstance(v, (int, float)) for v in vals.values())
+    assert hook.last == vals
