@@ -813,6 +813,144 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
     return 0
 
 
+def bench_faults_elastic(n_steps: int = 60, *, kill_step: int = 35,
+                         ckpt_every: int = 10, batch: int = 64,
+                         procs: int = 2, devices_per_process: int = 4) -> int:
+    """Elastic-resilience mode (`--faults --elastic`): run the SAME seeded
+    fault plan — a permanent non-chief host loss (`kill_host`, the victim
+    SIGKILLs itself at `kill_step`) — under two supervisors and compare
+    whole-run goodput:
+
+    - ELASTIC: the supervisor excludes the dead host and re-forms the
+      cluster at the surviving world size (shrink, no backoff); training
+      continues on the smaller mesh from the latest checkpoint
+      (resharding-by-construction restore).
+    - RESTART baseline: the PR-4 supervisor restarts the FULL world with
+      backoff — which, for a permanently lost host, means paying the
+      restart and then losing the host again would loop forever; here the
+      kill fires only in generation 0 (faults/inject.py), so the baseline
+      models the best case where the host happens to come back instantly.
+
+    Both runs share one journal schema, and `elastic_summary`
+    (faults/goodput.py) computes productive/wall and the uniform
+    failure→frontier recovery window from each, so `goodput_fraction` is
+    directly comparable. The headline is the ELASTIC fraction;
+    vs_baseline is elastic/restart (>1 means shrink-to-survive beat
+    restart-the-world on the same plan). Asserted: both runs complete all
+    steps, the elastic journal shows exactly a shrink resize (no
+    full-world restart), the baseline shows a restart (no resize), and
+    the elastic fraction is STRICTLY above the baseline's. Post-shrink
+    trajectory determinism is pinned separately in tests/test_elastic.py."""
+    import tempfile
+
+    from dist_mnist_tpu.cli.launch import launch
+    from dist_mnist_tpu.data import load_dataset
+    from dist_mnist_tpu.faults import Fault, FaultPlan
+    from dist_mnist_tpu.faults.goodput import elastic_summary
+    from dist_mnist_tpu.obs import events as events_mod
+
+    metric = "elastic_goodput_fraction"
+    plan = FaultPlan([Fault.kill_host(1, step=kill_step)])
+
+    with tempfile.TemporaryDirectory(prefix="bench_elastic_") as root:
+        data_dir = os.path.join(root, "data")
+        # materialize the dataset once so the children don't race the
+        # synthetic-twin cache write
+        dl = subprocess.run(
+            [sys.executable, "-m", "dist_mnist_tpu.cli.train",
+             "--download_only", f"--data_dir={data_dir}",
+             "--config=mlp_mnist", "--platform=cpu"],
+            capture_output=True, text=True, timeout=300,
+        )
+        if dl.returncode != 0:
+            raise RuntimeError(
+                f"dataset download child rc={dl.returncode}: "
+                f"{dl.stderr.strip()[-400:]}")
+
+        def supervised(tag: str, *, elastic: bool) -> dict:
+            journal = os.path.join(root, f"journal_{tag}.jsonl")
+            args = [
+                "--config=mlp_mnist", f"--data_dir={data_dir}",
+                f"--checkpoint_dir={os.path.join(root, 'ckpt_' + tag)}",
+                f"--train_steps={n_steps}", f"--batch_size={batch}",
+                "--eval_every=0", "--log_every=10",
+                # step-cadence checkpoints: one deterministically lands
+                # before the kill, so both runs restore the same frontier
+                f"--checkpoint_every_steps={ckpt_every}",
+                f"--fault_plan={plan.to_json()}",
+            ]
+            rc = launch(
+                procs, args, platform="cpu",
+                devices_per_process=devices_per_process,
+                max_restarts=procs - 1, restart_backoff_s=1.0,
+                journal=journal, elastic=elastic,
+                min_processes=1,
+                host_kill=plan.host_kill_spec() if elastic else None,
+            )
+            assert rc == 0, f"{tag} supervised run failed rc={rc}"
+            records = events_mod.read_journal(journal)
+            summary = elastic_summary(records)
+            summary["journal_events"] = [r.get("event") for r in records]
+            return summary
+
+        el = supervised("elastic", elastic=True)
+        rs = supervised("restart", elastic=False)
+
+    # the mechanisms must have actually engaged, each on its own side
+    assert [r for r in el["resizes"] if r["kind"] == "shrink"
+            and r["old_world"] == procs
+            and r["new_world"] == procs - 1], el["resizes"]
+    assert "supervisor_restart" not in el["journal_events"], (
+        "elastic run fell back to a full-world restart")
+    assert "supervisor_restart" in rs["journal_events"], (
+        "baseline never restarted — the fault did not engage")
+    assert not rs["resizes"], rs["resizes"]
+    assert el["final_step"] == n_steps, el
+    assert rs["final_step"] == n_steps, rs
+    el_frac, rs_frac = el["goodput_fraction"], rs["goodput_fraction"]
+    assert el_frac > rs_frac, (
+        f"elastic goodput {el_frac:.4f} did not beat the restart "
+        f"baseline {rs_frac:.4f} on the same fault plan")
+
+    dataset = load_dataset("mnist", "/tmp/mnist-data", seed=0)
+
+    def _side(s: dict) -> dict:
+        return {
+            "goodput_fraction": round(s["goodput_fraction"], 4),
+            "recovery_latency_s": round(s["recovery_latency_s"], 3),
+            "total_wall_s": round(s["total_wall_s"], 3),
+            "productive_s": round(s["productive_s"], 3),
+            "generations": s["generations"],
+            "recoveries": s["recoveries"],
+            "resizes": s["resizes"],
+            "final_step": s["final_step"],
+        }
+
+    emit({
+        "metric": metric,
+        "value": round(el_frac, 4),
+        "unit": "fraction",
+        "vs_baseline": round(el_frac / rs_frac, 3) if rs_frac > 0 else 0.0,
+        "synthetic_data": bool(dataset.synthetic),
+        "extra": {
+            "chips": procs * devices_per_process,
+            "processes": procs,
+            "devices_per_process": devices_per_process,
+            "global_batch": batch,
+            "steps": n_steps,
+            "kill_step": kill_step,
+            "ckpt_every_steps": ckpt_every,
+            "elastic": _side(el),
+            "restart_baseline": _side(rs),
+            "recovery_speedup": round(
+                rs["recovery_latency_s"] / el["recovery_latency_s"], 3
+            ) if el["recovery_latency_s"] > 0 else 0.0,
+            **_anchor_fields(metric, el_frac),
+        },
+    })
+    return 0
+
+
 def coldstart_child(cache_dir: str, n_steps: int) -> int:
     """One measured process of the cold/warm pair (`--coldstart-child`):
     build the LeNet-5 training step against the warm-start cache in
@@ -1323,6 +1461,12 @@ if __name__ == "__main__":
                          "recovery latency, goodput fraction, and a "
                          "bit-identical-trajectory check "
                          "(recovery_latency_ms)")
+    ap.add_argument("--elastic", action="store_true", dest="elastic_mode",
+                    help="with --faults: elastic-resilience mode — run the "
+                         "same seeded permanent-host-loss plan under the "
+                         "shrink-to-survive supervisor and the "
+                         "restart-the-world baseline and compare whole-run "
+                         "goodput (elastic_goodput_fraction)")
     ap.add_argument("--coldstart", action="store_true", dest="coldstart_mode",
                     help="cold-start mode: run the same short training job "
                          "in a cold process then a warm one sharing a "
@@ -1350,6 +1494,8 @@ if __name__ == "__main__":
               else "input_stall_ms_per_step" if args.input_mode
               else "fsdp_per_device_state_bytes" if args.memory_mode
               else "comm_exposed_ms_per_step" if args.overlap_mode
+              else "elastic_goodput_fraction"
+              if args.faults_mode and args.elastic_mode
               else "recovery_latency_ms" if args.faults_mode
               else "time_to_first_step_ms" if args.coldstart_mode
               else f"{args.config}_steps_per_sec_per_chip" if args.config
@@ -1376,6 +1522,8 @@ if __name__ == "__main__":
                  else bench_overlap(min(args.steps, 60),
                                     bucket_mb=args.bucket_mb)
                  if args.overlap_mode
+                 else bench_faults_elastic()
+                 if args.faults_mode and args.elastic_mode
                  else bench_faults() if args.faults_mode
                  else bench_coldstart(args.coldstart_steps)
                  if args.coldstart_mode

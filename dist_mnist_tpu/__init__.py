@@ -22,15 +22,47 @@ TPU-first rather than ported:
 
 Public surface is re-exported here; see each subpackage for the mapping to
 the reference component it replaces.
+
+Re-exports resolve lazily (PEP 562): the process SUPERVISOR
+(`cli/launch.py`) imports this package but must stay jax-free — it spawns
+and buries whole jax processes, and every elastic generation boundary
+would otherwise pay the multi-second jax import in the supervisor itself.
+Eagerly importing `cluster`/`train` here would drag jax in.
 """
 
-from dist_mnist_tpu.cluster import ClusterConfig, make_mesh, initialize_distributed
-from dist_mnist_tpu.configs import Config, get_config, CONFIGS
-from dist_mnist_tpu.train.state import TrainState
-from dist_mnist_tpu.train.loop import TrainLoop, StopSignal
-from dist_mnist_tpu.train.step import make_train_step, make_eval_step
+from __future__ import annotations
+
+_EXPORTS = {
+    "ClusterConfig": "dist_mnist_tpu.cluster.mesh",
+    "make_mesh": "dist_mnist_tpu.cluster.mesh",
+    "initialize_distributed": "dist_mnist_tpu.cluster.coordination",
+    "Config": "dist_mnist_tpu.configs",
+    "get_config": "dist_mnist_tpu.configs",
+    "CONFIGS": "dist_mnist_tpu.configs",
+    "TrainState": "dist_mnist_tpu.train.state",
+    "TrainLoop": "dist_mnist_tpu.train.loop",
+    "StopSignal": "dist_mnist_tpu.train.loop",
+    "make_train_step": "dist_mnist_tpu.train.step",
+    "make_eval_step": "dist_mnist_tpu.train.step",
+}
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name: str):
+    import importlib
+
+    module = _EXPORTS.get(name)
+    if module is not None:
+        return getattr(importlib.import_module(module), name)
+    # plain submodule access (`dist_mnist_tpu.configs` after a bare
+    # `import dist_mnist_tpu`) — the eager-init behavior callers may rely on
+    try:
+        return importlib.import_module(f"{__name__}.{name}")
+    except ModuleNotFoundError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
 
 __all__ = [
     "ClusterConfig",

@@ -44,6 +44,8 @@ from absl import app, flags
 # importing it first makes flag definitions order-independent for every
 # import order the package sees (its module top is cheap — stdlib + absl)
 import dist_mnist_tpu.cli.train  # noqa: F401
+# stdlib-only (cluster/__init__ resolves lazily, so no jax import here)
+from dist_mnist_tpu.cluster.membership import ENV_HOST_ID, Membership
 
 FLAGS = flags.FLAGS
 
@@ -58,6 +60,25 @@ flags.DEFINE_integer("max_restarts", 0,
 flags.DEFINE_float("restart_backoff_s", 1.0,
                    "supervisor restart backoff base: attempt k sleeps "
                    "base * 2^k * (1 + jitter)")
+flags.DEFINE_boolean("elastic", False,
+                     "shrink-to-survive supervisor: an abnormal non-chief "
+                     "death excludes that host and re-forms the cluster at "
+                     "the surviving world size (no backoff) instead of "
+                     "restarting the full world; recovered hosts grow the "
+                     "mesh back at the next generation boundary "
+                     "(docs/RESILIENCE.md 'Elastic generations')")
+flags.DEFINE_integer("min_processes", 1,
+                     "elastic mode: smallest world size worth forming; a "
+                     "shrink below this is fatal")
+flags.DEFINE_float("regrow_after_s", 0.0,
+                   "elastic mode: re-admit an UNATTRIBUTED lost host this "
+                   "many seconds after its failure (0 = only hosts with a "
+                   "planned kill_host recovery ever come back)")
+flags.DEFINE_integer("supervisor_port", None,
+                     "elastic mode: serve the SUPERVISOR's own "
+                     "/healthz+/metrics+/events on this port (0 = pick a "
+                     "free one); reports `resizing` (503) during mesh "
+                     "re-formation. Unset = no supervisor endpoint")
 
 #: children of the CURRENT cluster generation — the conftest leak check
 #: asserts this is empty of live processes after every test.
@@ -86,6 +107,13 @@ def _reserve_port() -> tuple[int, socket.socket, Path]:
     remain theoretically possible — children then fail to handshake and the
     launcher reports it (no silent cross-wiring: the coordinator checks
     num_processes/process_id consistency).
+
+    The bind itself retries: `bind(("localhost", 0))` can fail with
+    EADDRINUSE/EADDRNOTAVAIL under ephemeral-port exhaustion (an elastic
+    supervisor re-reserves a fresh port every generation, and parallel CI
+    shards multiply that), and one transient bind failure must not kill a
+    whole generation launch. Bounded so a genuinely exhausted/denied
+    network namespace still surfaces as the OS error, not a hang.
     """
     _PORT_LOCK_DIR.mkdir(exist_ok=True)
     now = time.time()
@@ -95,9 +123,15 @@ def _reserve_port() -> tuple[int, socket.socket, Path]:
                 stale.unlink()
         except OSError:
             pass
-    while True:
+    last_err: OSError | None = None
+    for _ in range(32):
         s = socket.socket()
-        s.bind(("localhost", 0))
+        try:
+            s.bind(("localhost", 0))
+        except OSError as e:
+            s.close()
+            last_err = e
+            continue  # transient EADDRINUSE etc.: fresh socket, fresh pick
         port = s.getsockname()[1]
         lock = _PORT_LOCK_DIR / str(port)
         try:
@@ -105,6 +139,9 @@ def _reserve_port() -> tuple[int, socket.socket, Path]:
             return port, s, lock
         except FileExistsError:
             s.close()  # reserved by a concurrent launcher; try another
+    raise OSError(
+        f"could not reserve a coordinator port after 32 attempts: {last_err}"
+    )
 
 
 def _pump(proc: subprocess.Popen, tag: str) -> None:
@@ -152,20 +189,32 @@ def _launch_once(
     child_command: list[str] | None = None,
     journal=None,
     generation: int = 0,
-) -> tuple[int, str | None, int | None]:
+    hosts: list[int] | None = None,
+    grow_after_s: float | None = None,
+) -> tuple[int, str | None, int | None, bool]:
     """Spawn ONE cluster generation and wait it out.
 
-    Returns ``(rc, failure, first_dead)``: rc is 0 or the normalized exit
-    status of the first abnormal death; `failure` describes that death
+    Returns ``(rc, failure, first_dead, grew)``: rc is 0 or the normalized
+    exit status of the first abnormal death; `failure` describes that death
     (None on success and on operator interrupt — the supervisor must not
-    "restart" a Ctrl-C); `first_dead` is the failing process index (the
-    chief-death-is-fatal input).
+    "restart" a Ctrl-C); `first_dead` is the failing HOST id (the
+    chief-death-is-fatal input); `grew` is True when the generation was
+    deliberately drained because an excluded host's recovery came due.
 
-    `kill_spec` = (process index, delay seconds) injects a launcher-level
-    chaos kill: SIGKILL that child `delay` seconds after spawn
-    (faults/plan.py kill_process). `child_command` replaces the
-    ``python -m dist_mnist_tpu.cli.train`` prefix — the supervisor tests'
-    seam for jax-free stub children."""
+    `hosts` maps per-generation process RANKS to stable host ids (elastic
+    mode launches the surviving subset; rank i is host hosts[i], exported
+    to the child as ``DIST_MNIST_TPU_HOST_ID``). Default: identity.
+    `kill_spec` = (host id, delay seconds) injects a launcher-level chaos
+    kill: SIGKILL that child `delay` seconds after spawn (faults/plan.py
+    kill_process). `grow_after_s` arms the elastic regrow drain: after
+    that many seconds, every child gets SIGTERM — the graceful-preemption
+    handshake (checkpoint at a step boundary, exit 0) — so the supervisor
+    can re-form a LARGER cluster at the next boundary. `child_command`
+    replaces the ``python -m dist_mnist_tpu.cli.train`` prefix — the
+    supervisor tests' seam for jax-free stub children."""
+    if hosts is None:
+        hosts = list(range(num_processes))
+    assert len(hosts) == num_processes
     probe, lock = None, None
     if not port:
         port, probe, lock = _reserve_port()
@@ -183,7 +232,9 @@ def _launch_once(
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
     killer: threading.Thread | None = None
-    killer_stop = threading.Event()
+    grower: threading.Thread | None = None
+    timer_stop = threading.Event()
+    grew = threading.Event()
     rc, failure, first_dead = 0, None, None
     try:
         for i in range(num_processes):
@@ -195,21 +246,27 @@ def _launch_once(
                 *([f"--platform={platform}"] if platform else []),
                 *train_args,
             ]
+            env_i = dict(env)
+            env_i[ENV_HOST_ID] = str(hosts[i])
             p = subprocess.Popen(
-                cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT
+                cmd, env=env_i,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT
             )
             procs.append(p)
             _LIVE_CHILDREN.append(p)
-            t = threading.Thread(target=_pump, args=(p, f"p{i}"), daemon=True)
+            t = threading.Thread(
+                target=_pump, args=(p, f"p{hosts[i]}"), daemon=True
+            )
             t.start()
             pumps.append(t)
-        if kill_spec is not None:
+        if kill_spec is not None and kill_spec[0] in hosts:
             k, delay = kill_spec
+            k_rank = hosts.index(k)
 
             def _chaos_kill():
-                if killer_stop.wait(delay):
+                if timer_stop.wait(delay):
                     return  # cluster ended first
-                victim = procs[k]
+                victim = procs[k_rank]
                 if victim.poll() is None:
                     _say(f"[launcher] fault injected: SIGKILL p{k} "
                          f"after {delay:.1f}s")
@@ -223,6 +280,32 @@ def _launch_once(
                 target=_chaos_kill, name=f"FaultKillTimer-p{k}", daemon=True
             )
             killer.start()
+        if grow_after_s is not None:
+            g_delay = max(0.05, grow_after_s)
+
+            def _grow_drain():
+                if timer_stop.wait(g_delay):
+                    return
+                live = [p for p in procs if p.poll() is None]
+                if not live:
+                    return
+                # graceful preemption handshake, not a kill: children
+                # checkpoint at a step boundary and exit 0, so the grown
+                # generation restores the freshest possible state
+                grew.set()
+                _say(f"[launcher] host recovery due: draining generation "
+                     f"{generation} ({len(live)} children, SIGTERM) to "
+                     f"grow the mesh")
+                if journal is not None:
+                    journal.emit("grow_drain", gen=generation,
+                                 children=len(live))
+                for p in live:
+                    p.send_signal(signal.SIGTERM)
+
+            grower = threading.Thread(
+                target=_grow_drain, name="ElasticGrowTimer", daemon=True
+            )
+            grower.start()
         # all children exist; release the port for the child coordinator
         # (children spend seconds in jax import before binding it)
         if probe is not None:
@@ -250,12 +333,19 @@ def _launch_once(
                 # when no worker died alongside it.
                 i, code = next(((j, c) for j, c in dead if j != 0), dead[0])
                 rc = _normalize_rc(code)
-                failure = _describe_exit(f"p{i}", code)
-                first_dead = i
-                _say(f"[launcher] {failure}; terminating "
-                     f"{len(alive)} peer(s)")
+                failure = _describe_exit(f"p{hosts[i]}", code)
+                first_dead = hosts[i]
+                # SIGKILL, not SIGTERM: with a peer already dead the
+                # survivors are parked in a collective they can never
+                # finish, and every graceful-exit path (even a checkpoint
+                # save) crosses another barrier with the same dead peer —
+                # a SIGTERM would just stall here until the coordination
+                # service's heartbeat timeout (~90s of pure downtime per
+                # generation). The checkpoint frontier is whatever the
+                # last cadence save already wrote.
+                _say(f"[launcher] {failure}; killing {len(alive)} peer(s)")
                 for j in sorted(alive):
-                    procs[j].terminate()
+                    procs[j].kill()
             if alive:
                 try:
                     procs[min(alive)].wait(timeout=0.5)
@@ -278,9 +368,11 @@ def _launch_once(
     finally:
         if probe is not None:
             probe.close()
-        killer_stop.set()
+        timer_stop.set()
         if killer is not None:
             killer.join(timeout=5)
+        if grower is not None:
+            grower.join(timeout=5)
         for p in procs:
             if p.poll() is None:
                 p.kill()
@@ -295,7 +387,7 @@ def _launch_once(
                 lock.unlink()
             except OSError:
                 pass
-    return rc, failure, first_dead
+    return rc, failure, first_dead, grew.is_set()
 
 
 def launch(
@@ -312,6 +404,12 @@ def launch(
     child_command: list[str] | None = None,
     compile_cache_dir: str | None = None,
     journal: str | None = None,
+    elastic: bool = False,
+    min_processes: int = 1,
+    regrow_after_s: float = 0.0,
+    host_kill: tuple[int, float | None] | None = None,
+    health=None,
+    supervisor_port: int | None = None,
 ) -> int:
     """Spawn the cluster; return 0 or a deterministic nonzero exit status
     (the first abnormal death's, signal deaths normalized to 128+N).
@@ -344,8 +442,32 @@ def launch(
     ``DIST_MNIST_TPU_GENERATION``) — so a fault-plan run leaves a single
     machine-readable record of the whole restart sequence. An explicit
     ``journal`` path survives the run; otherwise the journal lives inside
-    the supervisor-owned warm-start dir and is removed with it."""
+    the supervisor-owned warm-start dir and is removed with it.
+
+    ``elastic=True`` turns the restart-the-world supervisor into a
+    membership state machine (docs/RESILIENCE.md "Elastic generations"):
+    an abnormal non-chief death EXCLUDES that host (`cluster/membership`)
+    and the next generation re-forms immediately — fresh coordinator port,
+    surviving hosts only, smaller mesh, state restored (resharded) from
+    the latest checkpoint — with NO backoff: the failing host is out of
+    the new world, so there is nothing to back off from. Each shrink
+    emits a ``generation_resize`` journal event (old/new world size) and
+    consumes one of ``max_restarts``. A lost host re-joins when its
+    recovery comes due — ``host_kill=(host, recover_after_s)`` from a
+    seeded kill_host fault, or ``regrow_after_s`` for unattributed
+    deaths — by gracefully draining the shrunken generation (SIGTERM →
+    step-boundary checkpoint → exit 0) and growing the mesh back at the
+    next boundary (a grow consumes no restart budget). A shrink below
+    ``min_processes``, or any chief death, stays fatal. ``health`` (an
+    obs.exporter.HealthState) tracks the supervisor itself — it reports
+    ``resizing`` during mesh re-formation — and ``supervisor_port`` serves
+    it over /healthz (503 while resizing, so routers hold traffic)."""
     from dist_mnist_tpu.obs import events as events_mod
+
+    if elastic and max_restarts <= 0:
+        # elastic implies supervision; default budget = one resize per
+        # host that could possibly be lost
+        max_restarts = max(1, num_processes - 1)
 
     cache_dir_owned = False
     if max_restarts > 0 and compile_cache_dir is None and not any(
@@ -361,38 +483,101 @@ def launch(
         train_args = [*train_args, f"--compile_cache_dir={compile_cache_dir}"]
     if journal is None and max_restarts > 0 and compile_cache_dir is not None:
         journal = str(Path(compile_cache_dir) / "journal.jsonl")
+    if elastic and platform == "cpu" and child_command is None and not any(
+        a.startswith("--elastic_baseline_devices") for a in train_args
+    ):
+        # record the pre-shrink device count so every (possibly resized)
+        # generation can resolve the global-batch policy against it
+        # (configs.apply_elastic_policy); only the cpu simulator knows
+        # devices-per-process here — real TPU topologies pass it in
+        # train_args themselves
+        train_args = [
+            *train_args,
+            f"--elastic_baseline_devices="
+            f"{num_processes * devices_per_process}",
+        ]
     jrnl = events_mod.RunJournal(journal) if journal else None
     if jrnl is not None:
         _say(f"[supervisor] run journal: {journal}")
         jrnl.emit("supervisor_start", num_processes=num_processes,
-                  max_restarts=max_restarts)
+                  max_restarts=max_restarts, elastic=elastic)
+    membership = Membership(num_processes) if elastic else None
+    exporter = None
+    if supervisor_port is not None and supervisor_port >= 0 and elastic:
+        from dist_mnist_tpu.obs.exporter import HealthState, MetricsExporter
+
+        if health is None:
+            health = HealthState()
+        exporter = MetricsExporter(
+            health=health, journal_path=journal, port=supervisor_port
+        ).start()
+        _say(f"[supervisor] health endpoint: {exporter.url('/healthz')}")
     rng = random.Random(0)  # deterministic jitter (tests time the backoff)
-    attempt = 0
+    attempt = 0  # failure restarts/resizes consumed (bounded)
+    gen = 0  # journal generation number (grows also advance it)
 
     def _stop(rc: int) -> int:
         if jrnl is not None:
             jrnl.emit("supervisor_stop", rc=rc, restarts=attempt)
+        if health is not None:
+            health.set("stopped" if rc == 0 else "failed", f"rc={rc}")
         return rc
 
     try:
         while True:
+            hosts = None
+            grow_after = None
+            if membership is not None:
+                now = time.monotonic()
+                recovered = membership.restore_due(now)
+                if recovered:
+                    # failure boundary doubled as the grow boundary (the
+                    # generation died while a recovery was already due)
+                    _say(f"[supervisor] host(s) {recovered} recovered; "
+                         f"growing mesh to {membership.world_size}")
+                hosts = membership.alive()
+                grow_after = membership.next_recovery_in(now)
+            world = len(hosts) if hosts is not None else num_processes
             env_gen = dict(env_extra or {})
             if journal:
                 env_gen[events_mod.ENV_JOURNAL] = journal
-                env_gen[events_mod.ENV_GENERATION] = str(attempt)
+                env_gen[events_mod.ENV_GENERATION] = str(gen)
             if jrnl is not None:
-                jrnl.emit("generation_start", gen=attempt)
-            rc, failure, first_dead = _launch_once(
-                num_processes, train_args, port=port, platform=platform,
+                jrnl.emit("generation_start", gen=gen, world=world,
+                          hosts=hosts)
+            if health is not None:
+                health.set("training", f"gen={gen} world={world}")
+            rc, failure, first_dead, grew = _launch_once(
+                world, train_args, port=port, platform=platform,
                 devices_per_process=devices_per_process,
                 env_extra=env_gen or None,
-                kill_spec=kill_spec if attempt == 0 else None,
+                kill_spec=kill_spec if gen == 0 else None,
                 child_command=child_command,
-                journal=jrnl, generation=attempt,
+                journal=jrnl, generation=gen,
+                hosts=hosts, grow_after_s=grow_after,
             )
             if jrnl is not None:
-                jrnl.emit("generation_end", gen=attempt, rc=rc,
+                jrnl.emit("generation_end", gen=gen, rc=rc,
                           failure=failure, first_dead=first_dead)
+            if rc == 130 and failure is None:
+                return _stop(rc)  # operator interrupt — never re-formed
+            if grew and membership is not None:
+                # planned drain for regrow: not a failure, no backoff, no
+                # restart budget consumed
+                now = time.monotonic()
+                due = membership.restore_due(now)
+                old_world, new_world = world, membership.world_size
+                gen += 1
+                _say(f"[supervisor] generation resized {old_world} -> "
+                     f"{new_world} (grow: host(s) {due} back)")
+                if jrnl is not None:
+                    jrnl.emit("generation_resize", gen=gen, kind="grow",
+                              old_world=old_world, new_world=new_world,
+                              host=(due[0] if len(due) == 1 else due))
+                if health is not None:
+                    health.set("resizing",
+                               f"grow {old_world}->{new_world}")
+                continue
             if rc == 0 or failure is None or max_restarts <= 0:
                 return _stop(rc)
             if first_dead == 0:
@@ -403,9 +588,47 @@ def launch(
                 _say(f"[supervisor] {failure}; giving up after {attempt} "
                      f"restart(s), rc={rc}")
                 return _stop(rc)
+            if membership is not None and first_dead is not None:
+                # elastic shrink: exclude the lost host and re-form at the
+                # surviving world size IMMEDIATELY — the failing host is
+                # out of the next world, so crash-loop backoff would only
+                # add downtime
+                recover = None
+                if host_kill is not None and first_dead == host_kill[0]:
+                    recover = host_kill[1]
+                elif regrow_after_s and regrow_after_s > 0:
+                    recover = regrow_after_s
+                membership.fail(
+                    first_dead, now=time.monotonic(),
+                    recover_after_s=recover,
+                )
+                old_world, new_world = world, membership.world_size
+                if new_world < max(1, min_processes):
+                    _say(f"[supervisor] {failure}; surviving world size "
+                         f"{new_world} below min_processes="
+                         f"{min_processes}; fatal, rc={rc}")
+                    return _stop(rc)
+                attempt += 1
+                gen += 1
+                _say(f"[supervisor] {failure}; generation resized "
+                     f"{old_world} -> {new_world} (shrink: host "
+                     f"{first_dead} out"
+                     + (f", recovery in {recover:.1f}s" if recover
+                        else "")
+                     + f") — resize {attempt}/{max_restarts}, no backoff")
+                if jrnl is not None:
+                    jrnl.emit("generation_resize", gen=gen, kind="shrink",
+                              old_world=old_world, new_world=new_world,
+                              host=first_dead, recover_after_s=recover,
+                              failure=failure)
+                if health is not None:
+                    health.set("resizing",
+                               f"shrink {old_world}->{new_world}")
+                continue
             delay = (restart_backoff_s * (2 ** attempt)
                      * (1.0 + 0.5 * rng.random()))
             attempt += 1
+            gen += 1
             _say(f"[supervisor] {failure}; restarting cluster "
                  f"(attempt {attempt}/{max_restarts}) in {delay:.2f}s")
             if jrnl is not None:
@@ -413,6 +636,8 @@ def launch(
                           delay_s=round(delay, 3), failure=failure)
             time.sleep(delay)
     finally:
+        if exporter is not None:
+            exporter.close()
         if jrnl is not None:
             jrnl.close()
         if cache_dir_owned:
@@ -455,10 +680,16 @@ def main(argv):
     # --fault_plan is a cli.train flag, so the SAME plan is forwarded to
     # the children, which consume the in-process kinds
     kill_spec = None
+    host_kill = None
     if FLAGS.fault_plan:
         from dist_mnist_tpu.faults import FaultPlan
 
-        kill_spec = FaultPlan.from_spec(FLAGS.fault_plan).kill_spec()
+        plan = FaultPlan.from_spec(FLAGS.fault_plan)
+        kill_spec = plan.kill_spec()
+        # kill_host faults fire IN the victim (faults/inject.py) at their
+        # step; the supervisor only takes the attribution side — which
+        # host is a planned permanent loss, and when it recovers
+        host_kill = plan.host_kill_spec()
     rc = launch(
         FLAGS.num_processes,
         train_args,
@@ -470,6 +701,11 @@ def main(argv):
         kill_spec=kill_spec,
         compile_cache_dir=FLAGS.compile_cache_dir,
         journal=FLAGS.journal,
+        elastic=FLAGS.elastic,
+        min_processes=FLAGS.min_processes,
+        regrow_after_s=FLAGS.regrow_after_s,
+        host_kill=host_kill,
+        supervisor_port=FLAGS.supervisor_port,
     )
     if rc:
         sys.exit(rc)

@@ -154,6 +154,20 @@ flags.DEFINE_string("overlap_chunk", None,
                     "overlap chunking mode: all_gather (one collective per "
                     "bucket leaf) | ring (ppermute double-buffering, "
                     "collective_matmul-style); None = config value")
+flags.DEFINE_integer("checkpoint_every_steps", 0,
+                     "checkpoint cadence in STEPS (deterministic, for "
+                     "fault/elastic runs where a wall-clock cadence would "
+                     "make the pre-failure checkpoint timing racy); 0 = "
+                     "use the config's checkpoint_every_secs")
+flags.DEFINE_enum("elastic_batch_policy", None,
+                  ["keep_global", "scale_lr"],
+                  "global-batch policy under an elastic resize "
+                  "(configs.apply_elastic_policy; None = config value)")
+flags.DEFINE_integer("elastic_baseline_devices", 0,
+                     "device count of the UNSHRUNKEN mesh (the elastic "
+                     "supervisor injects this); with a resized mesh the "
+                     "elastic_batch_policy is applied against it and the "
+                     "decision is journaled. 0 = not elastic")
 
 
 def build_optimizer(cfg):
@@ -256,6 +270,8 @@ def _run_config(
     metrics_port: int = 0,
     journal=None,
     generation: int = 0,
+    checkpoint_every_steps: int = 0,
+    elastic_baseline_devices: int = 0,
 ):
     """Implementation behind `run_config` (the public wrapper adds the
     PRNG-impl scope — call THAT, not this).
@@ -317,10 +333,24 @@ def _run_config(
             max_restore_fallbacks=max_restore_fallbacks,
             compile_cache_dir=compile_cache_dir, startup=startup,
             registry=registry, health=health,
+            checkpoint_every_steps=checkpoint_every_steps,
+            elastic_baseline_devices=elastic_baseline_devices,
         )
+        import jax as _jax
+
+        # process/world/goodput on the success record: the supervisor-level
+        # elastic ledger (faults.goodput.elastic_summary) sums the CHIEF's
+        # per-generation productive seconds from exactly these fields
         events_mod.emit("run_stop", ok=True, step=state.step_int,
                         preempted_at=ctx.get("preempted_at"),
-                        reason=ctx["loop"].stop.reason)
+                        reason=ctx["loop"].stop.reason,
+                        process=_jax.process_index(),
+                        world=_jax.process_count(),
+                        devices=_jax.device_count(),
+                        goodput={
+                            k: (round(v, 6) if isinstance(v, float) else v)
+                            for k, v in ctx["loop"].goodput.snapshot().items()
+                        })
         ctx.update(
             registry=registry, health=health,
             journal=journal_obj.path if journal_obj else None,
@@ -362,6 +392,8 @@ def _run_train(
     startup=None,
     registry=None,
     health=None,
+    checkpoint_every_steps: int = 0,
+    elastic_baseline_devices: int = 0,
 ):
     """The training run itself (see `_run_config`, which wraps it in the
     observability scope and owns the exporter/journal lifecycles)."""
@@ -429,6 +461,27 @@ def _run_train(
         )
     with startup.phase("init"):
         mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+        if elastic_baseline_devices:
+            # resized-mesh batch/LR policy, resolved BEFORE the optimizer
+            # is built so the decision lives in the config this run logs
+            from dist_mnist_tpu.configs import apply_elastic_policy
+            from dist_mnist_tpu.obs import events as _events
+
+            n_dev = int(mesh.devices.size)
+            cfg = apply_elastic_policy(cfg, elastic_baseline_devices, n_dev)
+            _events.emit(
+                "elastic_policy", policy=cfg.elastic_batch_policy,
+                baseline_devices=elastic_baseline_devices, devices=n_dev,
+                batch_size=cfg.batch_size, learning_rate=cfg.learning_rate,
+            )
+            if n_dev != elastic_baseline_devices:
+                log.info(
+                    "elastic mesh: %d devices (baseline %d); policy=%s -> "
+                    "global batch %d, lr %g",
+                    n_dev, elastic_baseline_devices,
+                    cfg.elastic_batch_policy, cfg.batch_size,
+                    cfg.learning_rate,
+                )
         dataset = load_dataset(cfg.dataset, data_dir, seed=cfg.seed)
         model = get_model(cfg.model, **cfg.model_kwargs)
         optimizer = build_optimizer(cfg)
@@ -446,12 +499,26 @@ def _run_train(
     if compile_cache_dir:
         from pathlib import Path
 
-        cache_root = Path(compile_cache_dir)
-        enable_persistent_cache(cache_root / "xla")
-        store = ExecutableStore(cache_root / "exe")
-        key_fields = compile_cache_key_fields(
-            cfg, mesh, scan_chunk=scan_chunk, input_pipeline=input_pipeline)
-        step_key = lambda kind: cache_key({"kind": kind, **key_fields})  # noqa: E731
+        if jax.process_count() > 1 and jax.default_backend() == "cpu":
+            # a serialized multi-process CPU executable (either tier: the
+            # ExecutableStore AOT blob or the XLA persistent cache entry)
+            # embeds gloo communicator state from the incarnation that
+            # compiled it; deserializing it under a re-formed coordination
+            # service (restart/resize generation) corrupts the heap inside
+            # the first steps. Degrade the whole warm-start tier to a
+            # plain compile — correctness over cold-start here.
+            log.info(
+                "compile cache: disabled for multi-process cpu (serialized "
+                "collective state does not survive a new distributed "
+                "runtime incarnation)")
+        else:
+            cache_root = Path(compile_cache_dir)
+            enable_persistent_cache(cache_root / "xla")
+            store = ExecutableStore(cache_root / "exe")
+            key_fields = compile_cache_key_fields(
+                cfg, mesh, scan_chunk=scan_chunk,
+                input_pipeline=input_pipeline)
+            step_key = lambda kind: cache_key({"kind": kind, **key_fields})  # noqa: E731
 
     rng = jax.random.PRNGKey(cfg.seed)
     sample = dataset.train_images[:1]
@@ -563,6 +630,10 @@ def _run_train(
         if manager:
             hooks.append(
                 hooks_lib.CheckpointHook(
+                    manager, every_steps=checkpoint_every_steps
+                )
+                if checkpoint_every_steps
+                else hooks_lib.CheckpointHook(
                     manager, every_secs=cfg.checkpoint_every_secs
                 )
             )
@@ -659,6 +730,8 @@ def _apply_flag_overrides(cfg):
         over["mesh"] = MeshSpec(**{k: int(v) for k, v in kv.items()})
     if FLAGS.prng_impl:
         over["prng_impl"] = FLAGS.prng_impl
+    if FLAGS.elastic_batch_policy:
+        over["elastic_batch_policy"] = FLAGS.elastic_batch_policy
     if FLAGS.sharding:
         # validate EAGERLY (same rationale as remat_policy below): a typo'd
         # strategy must fail here, not silently train under the config's
@@ -781,6 +854,8 @@ def main(argv):
             metrics_port=metrics_port,
             journal=journal,
             generation=generation,
+            checkpoint_every_steps=FLAGS.checkpoint_every_steps,
+            elastic_baseline_devices=FLAGS.elastic_baseline_devices,
         )
     finally:
         uninstall()

@@ -1,4 +1,5 @@
-"""Cluster topology: device meshes and multi-host bootstrap.
+"""Cluster topology: device meshes, multi-host bootstrap, and elastic
+membership.
 
 Replaces the reference's cluster layer (SURVEY.md §2.2):
 - `tf.train.ClusterSpec` (server_lib.py:242-493) — a job→task→address map —
@@ -8,30 +9,37 @@ Replaces the reference's cluster layer (SURVEY.md §2.2):
   equivalent: there are no parameter servers. Multi-host control plane is
   `jax.distributed.initialize` (the TSL coordination service, the direct
   descendant of coordination_service_agent.h — SURVEY.md §2.5 row 29).
+- `Membership` (membership.py) is the elastic-generation ledger the
+  supervisor uses to decide shrink/grow (docs/RESILIENCE.md).
+
+Exports resolve lazily (PEP 562): `cli/launch.py` — a jax-free process
+supervisor — imports `cluster.membership`, and importing this package
+eagerly would drag `cluster.mesh`'s jax import into it.
 """
 
-from dist_mnist_tpu.cluster.mesh import (
-    ClusterConfig,
-    MeshSpec,
-    make_mesh,
-    activate,
-    local_batch_slice,
-    device_count,
-)
-from dist_mnist_tpu.cluster.coordination import (
-    force_platform,
-    initialize_distributed,
-    is_chief,
-)
+from __future__ import annotations
 
-__all__ = [
-    "ClusterConfig",
-    "MeshSpec",
-    "make_mesh",
-    "activate",
-    "local_batch_slice",
-    "device_count",
-    "force_platform",
-    "initialize_distributed",
-    "is_chief",
-]
+_EXPORTS = {
+    "ClusterConfig": "dist_mnist_tpu.cluster.mesh",
+    "MeshSpec": "dist_mnist_tpu.cluster.mesh",
+    "make_mesh": "dist_mnist_tpu.cluster.mesh",
+    "activate": "dist_mnist_tpu.cluster.mesh",
+    "local_batch_slice": "dist_mnist_tpu.cluster.mesh",
+    "device_count": "dist_mnist_tpu.cluster.mesh",
+    "force_platform": "dist_mnist_tpu.cluster.coordination",
+    "initialize_distributed": "dist_mnist_tpu.cluster.coordination",
+    "is_chief": "dist_mnist_tpu.cluster.coordination",
+    "ENV_HOST_ID": "dist_mnist_tpu.cluster.membership",
+    "Membership": "dist_mnist_tpu.cluster.membership",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

@@ -62,6 +62,15 @@ class Config:
     eval_every: int = 1000
     log_every: int = 100
     checkpoint_every_secs: float = 600.0  # CheckpointSaverHook default cadence
+    # Global-batch policy when an elastic resize changes the device count
+    # (cli/launch.py --elastic; see apply_elastic_policy):
+    #   keep_global — batch_size stays the GLOBAL batch; each surviving
+    #                 device's share grows, optimizer trajectory unchanged
+    #   scale_lr    — additionally scale learning_rate by
+    #                 current/baseline devices (linear-scaling rule run in
+    #                 reverse, for models whose per-device batch must not
+    #                 grow)
+    elastic_batch_policy: str = "keep_global"
     seed: int = 42
 
 
@@ -350,3 +359,37 @@ def get_config(name: str, **overrides) -> Config:
         raise KeyError(f"unknown config {name!r}; have {sorted(CONFIGS)}")
     cfg = CONFIGS[name]
     return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+ELASTIC_BATCH_POLICIES = ("keep_global", "scale_lr")
+
+
+def apply_elastic_policy(
+    cfg: Config, baseline_devices: int, current_devices: int
+) -> Config:
+    """Resolve the global-batch policy for an elastically resized mesh.
+
+    `batch_size` is GLOBAL everywhere in this repo, so under keep_global
+    (the default) a shrink needs no config change at all — `data/` slices
+    the same global batch across fewer devices and the optimizer sees an
+    identical gradient estimate; that invariance is what makes the
+    post-resize trajectory comparable to the unshrunken run's
+    hyperparameters. scale_lr is for models where the per-device batch
+    growth itself is the problem (activation memory): the returned config
+    records learning_rate scaled by current/baseline, so the decision is
+    IN the config object the run logs, not an untracked runtime side
+    effect.
+    """
+    if cfg.elastic_batch_policy not in ELASTIC_BATCH_POLICIES:
+        raise ValueError(
+            f"unknown elastic_batch_policy {cfg.elastic_batch_policy!r}; "
+            f"one of {ELASTIC_BATCH_POLICIES}"
+        )
+    if baseline_devices <= 0 or current_devices == baseline_devices:
+        return cfg
+    if cfg.elastic_batch_policy == "keep_global":
+        return cfg
+    return dataclasses.replace(
+        cfg,
+        learning_rate=cfg.learning_rate * current_devices / baseline_devices,
+    )

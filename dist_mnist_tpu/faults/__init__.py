@@ -24,6 +24,7 @@ _EXPORTS = {
     "FaultyStepFn": "dist_mnist_tpu.faults.inject",
     "GoodputClock": "dist_mnist_tpu.faults.goodput",
     "GoodputHook": "dist_mnist_tpu.faults.goodput",
+    "elastic_summary": "dist_mnist_tpu.faults.goodput",
     "PreemptionNotice": "dist_mnist_tpu.faults.preemption",
     "install_preemption_handlers": "dist_mnist_tpu.faults.preemption",
 }

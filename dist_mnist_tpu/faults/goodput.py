@@ -22,6 +22,14 @@ supervised restarts) has a metric to move:
                      A restart generation that warm-starts shows
                      milliseconds here where a cold one shows seconds —
                      the compile cost PR 4's supervisor made recurring.
+- ``resize_s``     — elastic mesh re-formation: the window between a
+                     membership change (host lost or recovered) and the
+                     first step of the re-formed generation. Priced
+                     separately from restore/replay because it is the
+                     cost the elastic supervisor (cli/launch.py
+                     --elastic) is designed to shrink: no backoff, no
+                     full-world restart, warm-started executables at the
+                     new mesh shape.
 
 ``goodput_fraction = productive_s / total_wall_s`` — everything not in
 the productive bucket (including untracked overhead: hook bodies, eval,
@@ -52,6 +60,7 @@ class GoodputClock:
         self.restore_s = 0.0
         self.stall_s = 0.0
         self.compile_s = 0.0
+        self.resize_s = 0.0
         self.replayed_steps = 0
         #: one dict per recovery: failed_at_step, restored_step, restore_s,
         #: replay_s, replayed_steps, complete, latency_s (once known)
@@ -74,6 +83,12 @@ class GoodputClock:
 
     def add_compile(self, dt: float) -> None:
         self.compile_s += dt
+
+    def add_resize(self, dt: float) -> None:
+        """Mesh re-formation time (elastic shrink/grow). Fed by harnesses
+        that observe the whole supervised run — an individual generation
+        cannot see its own bring-up window."""
+        self.resize_s += dt
 
     @property
     def in_replay(self) -> bool:
@@ -149,6 +164,7 @@ class GoodputClock:
             "restore_s": self.restore_s,
             "stall_s": self.stall_s,
             "compile_s": self.compile_s,
+            "resize_s": self.resize_s,
             "total_wall_s": self.total_wall_s(),
             "goodput_fraction": self.goodput_fraction(),
             "recoveries": len(self.events),
@@ -197,3 +213,167 @@ class GoodputHook:
             self._writer.scalars(
                 {f"goodput/{k}": v for k, v in snap.items()}, step
             )
+
+
+def elastic_summary(records) -> dict:
+    """Whole-SUPERVISED-run goodput from a run journal's parsed records.
+
+    A GoodputClock lives inside one generation's train loop; it cannot see
+    the supervisor's re-formation windows (child spawn, coordinator
+    bring-up, backoff) or sum across generations. This ledger can, because
+    the supervisor and every child generation share one journal
+    (obs/events.py ENV_JOURNAL):
+
+    - wall        — ``supervisor_start`` .. last ``supervisor_stop`` ts.
+    - productive  — FULL-MESH-EQUIVALENT seconds of frontier progress:
+                    ``frontier_steps / healthy_rate``, where the healthy
+                    rate is measured from this same journal's
+                    generation-0 evidence (chief ``first_step`` to the
+                    last gen-0 ``checkpoint_save``). Raw busy-seconds
+                    would reward a DEGRADED world — a shrunken mesh steps
+                    slower, banking more "productive" wall for the same
+                    progress — so cross-world-size comparisons (elastic
+                    shrink vs full restart) must price progress, not
+                    occupancy. When the journal lacks the gen-0 evidence
+                    (no first_step/checkpoint cadence), falls back to
+                    summing the chief's per-generation ``run_stop``
+                    ``goodput.productive_s``.
+    - resize      — per membership/restart transition: the failed (or
+                    drained) generation's ``generation_end`` ts to the
+                    next chief ``first_step`` ts. This is the
+                    failure→frontier recovery window, uniform across
+                    elastic resizes and full restarts, so
+                    ``recovery_latency_s`` is directly comparable.
+
+    Returns goodput_fraction = productive / wall plus the resize ledger.
+    Works on any journal: a run with no resizes just reports zero
+    recoveries. Stdlib-only like the rest of this module.
+    """
+    recs = [r for r in records if isinstance(r, dict)]
+    t0 = next(
+        (r.get("ts") for r in recs if r.get("event") == "supervisor_start"),
+        None,
+    )
+    t1 = next(
+        (
+            r.get("ts")
+            for r in reversed(recs)
+            if r.get("event") == "supervisor_stop"
+        ),
+        None,
+    )
+    wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
+
+    busy = 0.0
+    final_step = None
+    for r in recs:
+        if (
+            r.get("event") == "run_stop"
+            and r.get("process", 0) == 0
+            and isinstance(r.get("goodput"), dict)
+        ):
+            busy += float(r["goodput"].get("productive_s", 0.0))  # host-sync-ok: parses a journal JSON float, no device value
+            if r.get("step") is not None:
+                final_step = r["step"]
+
+    # healthy full-mesh step rate from generation 0's own evidence: chief
+    # first_step -> the last gen-0 checkpoint_save (cadence checkpoints
+    # carry step + ts). Both sides of an elastic-vs-restart comparison
+    # measure their own rate from an identical healthy generation 0, so
+    # the normalization cancels out of the ratio.
+    g0_first = next(
+        (r for r in recs if r.get("event") == "first_step"
+         and r.get("gen", 0) == 0 and r.get("process", 0) == 0),
+        None,
+    )
+    g0_saves = [r for r in recs if r.get("event") == "checkpoint_save"
+                and r.get("gen", 0) == 0 and r.get("step") is not None
+                and r.get("ts") is not None]
+    healthy_rate = 0.0
+    if g0_first is not None and g0_first.get("ts") is not None and g0_saves:
+        last = max(g0_saves, key=lambda r: r["ts"])
+        dt = last["ts"] - g0_first["ts"]
+        dstep = last["step"] - g0_first.get("step", 0)
+        if dt > 0 and dstep > 0:
+            healthy_rate = dstep / dt
+
+    # frontier reached: prefer the final run_stop step, fall back to any
+    # frontier evidence (a run killed before its run_stop still made
+    # progress worth counting)
+    frontier = final_step
+    if frontier is None:
+        frontier = max(
+            (r.get("step", 0) for r in recs
+             if r.get("event") in ("checkpoint_save", "first_step")),
+            default=None,
+        )
+    if healthy_rate > 0 and frontier:
+        productive = frontier / healthy_rate
+    else:
+        productive = busy
+
+    # one recovery window per non-initial generation: previous
+    # generation_end -> first chief first_step at or after the new start
+    gen_starts = sorted(
+        (
+            r
+            for r in recs
+            if r.get("event") == "generation_start" and r.get("gen", 0) > 0
+        ),
+        key=lambda r: r.get("ts", 0.0),
+    )
+    gen_ends = sorted(
+        (r for r in recs if r.get("event") == "generation_end"),
+        key=lambda r: r.get("ts", 0.0),
+    )
+    first_steps = sorted(
+        (
+            r
+            for r in recs
+            if r.get("event") == "first_step" and r.get("process", 0) == 0
+        ),
+        key=lambda r: r.get("ts", 0.0),
+    )
+    latencies = []
+    for s in gen_starts:
+        ts = s.get("ts", 0.0)
+        prev_end = next(
+            (e for e in reversed(gen_ends) if e.get("ts", 0.0) <= ts), None
+        )
+        nxt = next((f for f in first_steps if f.get("ts", 0.0) >= ts), None)
+        if prev_end is not None and nxt is not None:
+            latencies.append(nxt["ts"] - prev_end["ts"])
+
+    resizes = [
+        {
+            "kind": r.get("kind"),
+            "old_world": r.get("old_world"),
+            "new_world": r.get("new_world"),
+            "host": r.get("host"),
+        }
+        for r in recs
+        if r.get("event") == "generation_resize"
+    ]
+    n_gens = 1 + max(
+        (
+            r.get("gen", 0)
+            for r in recs
+            if r.get("event") == "generation_start"
+        ),
+        default=0,
+    )
+    return {
+        "total_wall_s": wall,
+        "productive_s": productive,
+        "busy_s": busy,
+        "healthy_steps_per_s": healthy_rate,
+        "resize_s": sum(latencies),
+        "goodput_fraction": productive / wall if wall > 0 else 0.0,
+        "recovery_latency_s": (
+            sum(latencies) / len(latencies) if latencies else 0.0
+        ),
+        "recoveries": len(latencies),
+        "generations": n_gens,
+        "resizes": resizes,
+        "final_step": final_step,
+    }

@@ -59,6 +59,38 @@ class FaultInjectionHook:
                 log.warning("fault injected: preemption at step %d", step)
                 events.emit("fault_injected", kind="preempt", step=step)
                 raise PreemptionError(f"injected preemption at step {step}")
+        self._maybe_kill_host(step)
+
+    def _maybe_kill_host(self, step: int) -> None:
+        # kill_host: the VICTIM SIGKILLs itself at an exact step —
+        # deterministic against import/compile wall-time variance, unlike
+        # the launcher's after_s kill timer. Fires in generation 0 only:
+        # restart/resized generations re-parse the plan JSON with fresh
+        # `fired` latches, and a restored worker replaying past the
+        # trigger step must not die again (the loss already happened; the
+        # elastic supervisor tracks it via membership, not re-injection).
+        for f in self.plan.pending("kill_host"):
+            if f.step is None or step < f.step:
+                continue
+            import os
+
+            if int(os.environ.get(events.ENV_GENERATION, "0") or 0) != 0:
+                f.fired = True
+                continue
+            import jax
+
+            if jax.process_index() != (f.process or 0):
+                continue
+            f.fired = True
+            log.warning(
+                "fault injected: kill_host p%d (SIGKILL self) at step %d",
+                f.process or 0, step,
+            )
+            events.emit("fault_injected", kind="kill_host", step=step,
+                        process=f.process or 0)
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def after_step(self, step: int, state, outputs) -> None:
         pass

@@ -18,9 +18,25 @@ stall_input         loop step >= ``step``      FaultyBatches (sleeps
                                                ``seconds`` in the feed)
 kill_process        ``after_s`` after spawn    cli/launch.py supervisor
                                                (SIGKILLs child ``process``)
+kill_host           loop step >= ``step``,     FaultInjectionHook on the
+                    generation 0 only          victim (SIGKILLs ITSELF) +
+                                               cli/launch.py --elastic
+                                               (excludes the host from
+                                               later generations until
+                                               ``recover_after_s`` elapses)
 serve_error         predict call >= ``request``FaultyEngine (raises into
                                                the DynamicBatcher)
 =================== ========================== ==========================
+
+``kill_host`` vs ``kill_process``: a kill_process is a transient crash —
+the same process index comes back in the next (full-size) generation. A
+kill_host models permanent host loss: the victim dies at an exact step
+(deterministic against import/compile time variance, and only in
+generation 0 so restore+replay never re-fires it), and the elastic
+supervisor excludes that host from every following generation until its
+planned recovery — ``recover_after_s`` wall seconds after the failure is
+observed (None = never), at which point the next generation boundary grows
+the mesh back.
 
 Every fault fires AT MOST ONCE (`fired` latches), so a replayed step
 range after a restore does not re-trigger the same fault — which is what
@@ -44,6 +60,7 @@ KINDS = (
     "corrupt_checkpoint",
     "stall_input",
     "kill_process",
+    "kill_host",
     "serve_error",
 )
 
@@ -56,6 +73,7 @@ class Fault:
     process: int | None = None  # kill_process target index
     after_s: float | None = None  # kill_process delay after spawn
     request: int | None = None  # serve_error predict-call ordinal (0-based)
+    recover_after_s: float | None = None  # kill_host: planned recovery delay
     mode: str = "truncate"  # corrupt_checkpoint: truncate | delete
     fired: bool = False  # latched by the consumer on injection
 
@@ -84,12 +102,36 @@ class Fault:
         return cls("kill_process", process=process, after_s=after_s)
 
     @classmethod
+    def kill_host(
+        cls,
+        process: int,
+        step: int,
+        recover_after_s: float | None = None,
+    ) -> "Fault":
+        """Permanent loss of host ``process`` at train step ``step``;
+        re-admitted ``recover_after_s`` seconds after the failure is seen
+        by the supervisor (None = stays out for the whole run)."""
+        return cls(
+            "kill_host",
+            process=process,
+            step=step,
+            recover_after_s=recover_after_s,
+        )
+
+    @classmethod
     def serve_error(cls, request: int = 0) -> "Fault":
         return cls("serve_error", request=request)
 
     def to_dict(self) -> dict:
         out = {"kind": self.kind}
-        for field in ("step", "seconds", "process", "after_s", "request"):
+        for field in (
+            "step",
+            "seconds",
+            "process",
+            "after_s",
+            "request",
+            "recover_after_s",
+        ):
             v = getattr(self, field)
             if v is not None:
                 out[field] = v
@@ -146,6 +188,16 @@ class FaultPlan:
         kill actually lands."""
         for f in self.pending("kill_process"):
             return f.process or 0, f.after_s or 0.0
+        return None
+
+    def host_kill_spec(self) -> tuple[int, float | None] | None:
+        """(host id, recover_after_s) of the first pending kill_host —
+        the ATTRIBUTION side for the elastic supervisor (the kill itself
+        lands in-child via FaultInjectionHook at the fault's step). Not
+        latched: the victim latches its own copy of the plan when it
+        fires."""
+        for f in self.pending("kill_host"):
+            return f.process or 0, f.recover_after_s
         return None
 
     # -- wiring helpers (lazy imports; see faults/inject.py) ----------------

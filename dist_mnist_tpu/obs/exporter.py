@@ -9,12 +9,17 @@ each GET — no background sampling loop, nothing to fall behind.
 Health is a tiny explicit state machine rather than a boolean:
 
     starting -> training | serving -> draining | preempted -> stopped
-                                                            | failed
+                                   -> resizing               | failed
 
 ``/healthz`` returns 200 while the process is doing useful work
 (starting/training/serving) and 503 otherwise, so a fleet router can
 stop sending traffic to a draining replica before it disappears
 (ROADMAP "replica health/drain integration with the supervisor").
+``resizing`` is the elastic supervisor's mesh re-formation window
+(cli/launch.py --elastic, docs/RESILIENCE.md "Elastic generations"): a
+membership change was decided and the next generation has not started
+yet — deliberately NOT healthy, so routers hold traffic exactly like a
+drain.
 
 Threads are named ``ObsExporter*`` and live exporters are tracked in
 ``_LIVE_EXPORTERS`` so the conftest leak-check can prove every test
@@ -41,8 +46,8 @@ _LIVE_EXPORTERS: list = []
 
 _HEALTHY = frozenset({"starting", "training", "serving"})
 _STATES = frozenset(
-    {"starting", "training", "serving", "draining", "preempted",
-     "stopped", "failed"})
+    {"starting", "training", "serving", "draining", "resizing",
+     "preempted", "stopped", "failed"})
 
 
 class HealthState:

@@ -30,6 +30,7 @@ from dist_mnist_tpu.parallel.sharding import (
     FSDP_RULES,
     FSDP_TP_RULES,
     derive_state_specs,
+    reshard_state,
     shard_train_state,
     params_sharding,
     tree_sharding,
@@ -48,6 +49,7 @@ __all__ = [
     "FSDP_RULES",
     "FSDP_TP_RULES",
     "derive_state_specs",
+    "reshard_state",
     "shard_train_state",
     "params_sharding",
     "tree_sharding",

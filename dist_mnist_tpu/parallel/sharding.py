@@ -304,3 +304,37 @@ def shard_train_state(state, mesh: Mesh, rules: ShardingRules = DP_RULES):
     # cross-host equality check buys nothing: build each global array
     # directly from the local copy instead, no collective at all.
     return jax.tree.map(_put_via_callback, state, sharded)
+
+
+def reshard_state(state, mesh: Mesh, rules: ShardingRules = DP_RULES):
+    """Live spec migration: move an EXISTING (already-placed) state onto
+    `mesh` under `rules`, re-deriving every leaf's spec for the new shape.
+
+    This is the in-memory half of the elastic-resize story
+    (docs/RESILIENCE.md "Elastic generations"): `derive_state_specs` is
+    world-size-parameterized — the same rule set yields different
+    PartitionSpecs on an 8- vs 4-device mesh (an fsdp dim that divides 8
+    but not 4 falls back to replicated per leaf) — so resharding is just
+    "derive specs against the NEW mesh, then move the bytes". Values are
+    bitwise-preserved: the fast path lets XLA reshuffle device buffers
+    (`device_put` handles cross-mesh moves when both sides are fully
+    addressable), the general path round-trips through the host.
+
+    Cross-PROCESS live migration is not attempted here: a shrunken world
+    restores from the latest checkpoint instead (checkpoint/manager.py
+    builds the abstract target with the new mesh's shardings, which is
+    this same respec applied at restore time).
+    """
+    sharded = tree_sharding(state, mesh, rules)
+
+    def _move(leaf, sharding):
+        if not isinstance(leaf, jax.Array):
+            return _put_via_callback(leaf, sharding)
+        if leaf.is_fully_addressable and sharding.is_fully_addressable:
+            return jax.device_put(leaf, sharding)
+        arr = jax.device_get(leaf)  # raises on non-addressable source:
+        # live cross-process migration goes via checkpoint, by design
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
+
+    return jax.tree.map(_move, state, sharded)

@@ -140,6 +140,7 @@ class TrainLoop:
         self.initial_step = state.step_int
         self._host_step = self.initial_step  # host mirror of state.step:
         # tracks the global step without a device sync per step
+        self._first_step_emitted = False  # first_step journal latch
 
     def request_stop(self, reason: str | None = None) -> None:
         self.stop.request_stop(reason)
@@ -240,6 +241,14 @@ class TrainLoop:
                                       at_step=self._host_step)
                     else:
                         g.add_productive(dt_step)
+                    if not self._first_step_emitted:
+                        # one journal mark per process run: closes the
+                        # supervisor-level failure->frontier window that
+                        # faults.goodput.elastic_summary measures across
+                        # generations
+                        self._first_step_emitted = True
+                        events.emit("first_step", step=self._host_step,
+                                    process=jax.process_index())
                 except Exception as exc:  # noqa: BLE001 — classified below
                     # in-flight outputs reference pre-failure buffers;
                     # waiting on them after a restore could resurface the

@@ -39,10 +39,18 @@ def format_record(rec: dict) -> str:
     gen = rec.get("gen", "?")
     pid = rec.get("pid", "?")
     event = rec.get("event", "?")
+    skip = _FIXED
+    head = ""
+    if event == "generation_resize":
+        # The one event an operator scans for: show the world transition
+        # inline (`shrink 4->3 host=2`) ahead of the remaining fields.
+        head = (f"{rec.get('kind', '?')} {rec.get('old_world', '?')}->"
+                f"{rec.get('new_world', '?')} host={rec.get('host', '?')} ")
+        skip = _FIXED + ("kind", "old_world", "new_world", "host")
     extras = " ".join(
-        f"{k}={rec[k]}" for k in rec if k not in _FIXED and rec[k] is not None
+        f"{k}={rec[k]}" for k in rec if k not in skip and rec[k] is not None
     )
-    return f"{clock}  g{gen}  {pid:>7}  {event:<20} {extras}".rstrip()
+    return f"{clock}  g{gen}  {pid:>7}  {event:<20} {head}{extras}".rstrip()
 
 
 def render_line(raw: str) -> str | None:

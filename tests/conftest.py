@@ -57,7 +57,8 @@ def _no_leaked_prefetch_workers():
     """Every background resource must be drained by test end: prefetch
     workers (a leak means some path — exception, early close, re-seek —
     skipped the stream drain), fault-injection timer threads (``Fault*``,
-    cli/launch.py's chaos kill), supervisor child PROCESSES (a live
+    cli/launch.py's chaos kill), elastic grow-drain timers
+    (``ElasticGrowTimer``), supervisor child PROCESSES (a live
     child after launch() returned would outlive the test and poison the
     next one's port/coordinator), compile-cache atomic-write temp files
     (compilecache/store.py `_PENDING_TMP` — a pending entry means a save
@@ -89,6 +90,7 @@ def _no_leaked_prefetch_workers():
                   if t.is_alive()
                   and (t.name.startswith(THREAD_NAME_PREFIX)
                        or t.name.startswith("Fault")
+                       or t.name.startswith("Elastic")
                        or t.name.startswith("CompileCache")
                        or t.name.startswith("ObsExporter"))]
         exporter_mod = sys.modules.get("dist_mnist_tpu.obs.exporter")
