@@ -662,13 +662,17 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
     restore — replay, not skip): a resilience mechanism that perturbs the
     math would be worse than the fault it hides.
 
-    The FAULT run is additionally instrumented with a run journal
-    (obs/events.py) while the clean run stays obs-disabled — so the
-    trajectory assert above doubles as proof that observability is free:
-    the instrumented trajectory is bit-identical to an uninstrumented one.
-    The journal is cross-checked against the loop's own accounting
-    (restore events == goodput recoveries) and the step-time distribution
-    (obs/hist.py, the /metrics histogram layer) rides along in extra."""
+    The FAULT run is additionally instrumented with the FULL observability
+    stack — run journal (obs/events.py), AnomalyHook (obs/anomaly.py),
+    and a live fleet-of-one: a /metrics exporter scraped by a FleetScraper
+    (obs/fleet.py) polling concurrently with training — while the clean
+    run stays obs-disabled. The trajectory assert above therefore doubles
+    as proof that observability is free: the fully-instrumented trajectory
+    is bit-identical to an uninstrumented one. The journal is
+    cross-checked against the loop's own accounting (restore events ==
+    goodput recoveries) and the step-time distribution (obs/hist.py, the
+    /metrics histogram layer) plus the fleet-scrape stats ride along in
+    extra."""
     import tempfile
 
     import jax
@@ -721,9 +725,15 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
         # donate=False so both runs consume the same initial buffers
         step = make_train_step(model, optimizer, mesh, donate=False)
 
-        def run(plan=None, ckpt_dir=None):
+        def run(plan=None, ckpt_dir=None, instrumented=False):
             traj = _Trajectory()
             hooks = [hooks_lib.StopAtStepHook(last_step=n_steps), traj]
+            anomaly = None
+            if instrumented:
+                from dist_mnist_tpu.obs.anomaly import AnomalyHook
+
+                anomaly = AnomalyHook(every_steps=10)
+                hooks.append(anomaly)
             manager = None
             if ckpt_dir:
                 manager = CheckpointManager(ckpt_dir, async_save=False,
@@ -738,12 +748,46 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
                 batches = plan.wrap_batches(batches)
             loop = TrainLoop(step, state0, batches, hooks,
                              checkpoint_manager=manager, max_recoveries=3)
-            loop.run()
+            exporter = scraper = obs_stats = None
+            if instrumented:
+                # fleet-of-one scraping the live run: exporter serves the
+                # loop's step-time histogram, the scraper polls it
+                # concurrently with training — exactly the supervisor-side
+                # fleet path, pointed at one host
+                from dist_mnist_tpu.obs import MetricRegistry, MetricsExporter
+                from dist_mnist_tpu.obs.fleet import FleetScraper
+
+                registry = MetricRegistry()
+                registry.attach_histogram("train/step_time_ms",
+                                          loop.step_time_hist)
+                exporter = MetricsExporter(
+                    registry, port=0,
+                    info={"host_id": "0", "generation": "0",
+                          "role": "train"},
+                ).start()
+                scraper = FleetScraper(interval_s=0.05)
+                scraper.set_targets({0: exporter.url("")})
+                scraper.start()
+            try:
+                loop.run()
+            finally:
+                if scraper is not None:
+                    scraper.scrape_once()  # final deterministic pass
+                    snap = scraper.snapshot()
+                    obs_stats = {
+                        "scrapes": snap["scrapes"],
+                        "scrape_errors": snap["scrape_errors"],
+                        "host_reachable": snap["hosts"][0]["reachable"],
+                        "anomalies": len(anomaly.anomalies),
+                    }
+                    scraper.close()
+                if exporter is not None:
+                    exporter.close()
             if manager:
                 manager.close()
-            return traj.loss, loop
+            return traj.loss, loop, obs_stats
 
-        clean_loss, _ = run()  # obs-disabled: no journal installed
+        clean_loss, _, _ = run()  # obs-disabled: no journal installed
         plan = FaultPlan([
             Fault.preempt(preempt_at),
             # target the checkpoint the restore will want (the save at the
@@ -754,7 +798,8 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
             journal_path = os.path.join(ckpt_dir, "journal.jsonl")
             prev = events_mod.set_journal(events_mod.RunJournal(journal_path))
             try:
-                fault_loss, fault_loop = run(plan=plan, ckpt_dir=ckpt_dir)
+                fault_loss, fault_loop, obs_stats = run(
+                    plan=plan, ckpt_dir=ckpt_dir, instrumented=True)
             finally:
                 j = events_mod.set_journal(prev)
                 if j is not None:
@@ -767,10 +812,13 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
         for s in clean_loss))
     assert identical, (
         "recovered loss trajectory diverged from the fault-free run "
-        "(the fault run was the journal-instrumented one: observability "
-        "must not perturb the math)")
+        "(the fault run carried the full obs stack — journal, AnomalyHook, "
+        "live fleet scraper: observability must not perturb the math)")
     assert all(f.fired for f in plan.faults), (
         f"planned faults did not all fire: {plan.to_json()}")
+    assert obs_stats is not None and obs_stats["host_reachable"] and (
+        obs_stats["scrapes"] >= 1), (
+        f"fleet-of-one scraper never reached the live exporter: {obs_stats}")
     snap = goodput.snapshot()
     # journal cross-check: the lifecycle record must agree with the loop's
     # own goodput accounting, restart for restart
@@ -807,6 +855,8 @@ def bench_faults(n_steps: int = 60, *, preempt_at: int = 40,
             "step_time_ms": {k: round(v, 3) for k, v in step_pcts.items()},
             "journal_events": journal_events,
             "journal_restores": journal_restores,
+            # fleet-of-one scrape stats (obs/fleet.py polled the live run)
+            "fleet": obs_stats,
             **_anchor_fields(metric, snap["recovery_latency_ms"]),
         },
     })
@@ -840,7 +890,16 @@ def bench_faults_elastic(n_steps: int = 60, *, kill_step: int = 35,
     steps, the elastic journal shows exactly a shrink resize (no
     full-world restart), the baseline shows a restart (no resize), and
     the elastic fraction is STRICTLY above the baseline's. Post-shrink
-    trajectory determinism is pinned separately in tests/test_elastic.py."""
+    trajectory determinism is pinned separately in tests/test_elastic.py.
+
+    The elastic side additionally runs the FULL fleet-observability path:
+    children expose /metrics (--metrics_port base+rank) and emit
+    cadence-gated span records (--span_steps), the supervisor runs the
+    FleetScraper (supervisor_port), and afterwards
+    scripts/fleet_trace.py merges the host-stamped journal into a chrome
+    trace — asserted to contain per-host tracks (>= world size) and the
+    shrink resize marker, i.e. correlated step tracing survives a mesh
+    resize. Trace stats ride along in extra."""
     import tempfile
 
     from dist_mnist_tpu.cli.launch import launch
@@ -851,6 +910,33 @@ def bench_faults_elastic(n_steps: int = 60, *, kill_step: int = 35,
 
     metric = "elastic_goodput_fraction"
     plan = FaultPlan([Fault.kill_host(1, step=kill_step)])
+
+    def _free_port_block(n: int) -> int | None:
+        """A base port with n consecutive free ports (children bind
+        metrics_port base+rank). Best-effort: probed then released, so a
+        race is possible — child exporters degrade gracefully (warn and
+        run unexposed) if it loses."""
+        import socket
+
+        for _ in range(20):
+            with socket.socket() as probe:
+                probe.bind(("127.0.0.1", 0))
+                base = probe.getsockname()[1]
+            if base + n >= 65535:
+                continue
+            held = []
+            try:
+                for i in range(n):
+                    s = socket.socket()
+                    s.bind(("127.0.0.1", base + i))
+                    held.append(s)
+                return base
+            except OSError:
+                continue
+            finally:
+                for s in held:
+                    s.close()
+        return None
 
     with tempfile.TemporaryDirectory(prefix="bench_elastic_") as root:
         data_dir = os.path.join(root, "data")
@@ -879,6 +965,16 @@ def bench_faults_elastic(n_steps: int = 60, *, kill_step: int = 35,
                 f"--checkpoint_every_steps={ckpt_every}",
                 f"--fault_plan={plan.to_json()}",
             ]
+            supervisor_port = None
+            if elastic:
+                # fleet observability on the elastic side: child /metrics,
+                # span records for the trace, supervisor-side FleetScraper
+                # on an ephemeral port (launch resolves port 0 itself)
+                metrics_base = _free_port_block(procs)
+                if metrics_base is not None:
+                    args.append(f"--metrics_port={metrics_base}")
+                args.append(f"--span_steps={ckpt_every}")
+                supervisor_port = 0
             rc = launch(
                 procs, args, platform="cpu",
                 devices_per_process=devices_per_process,
@@ -886,15 +982,44 @@ def bench_faults_elastic(n_steps: int = 60, *, kill_step: int = 35,
                 journal=journal, elastic=elastic,
                 min_processes=1,
                 host_kill=plan.host_kill_spec() if elastic else None,
+                supervisor_port=supervisor_port,
             )
             assert rc == 0, f"{tag} supervised run failed rc={rc}"
             records = events_mod.read_journal(journal)
             summary = elastic_summary(records)
             summary["journal_events"] = [r.get("event") for r in records]
+            summary["journal_path"] = journal
             return summary
 
         el = supervised("elastic", elastic=True)
         rs = supervised("restart", elastic=False)
+
+        # correlated step tracing must survive the resize: merge the
+        # host-stamped elastic journal into one chrome trace and check the
+        # per-host tracks + the shrink marker are all there
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        try:
+            from fleet_trace import build_fleet_trace
+        finally:
+            sys.path.pop(0)
+        trace = build_fleet_trace(el["journal_path"])["traceEvents"]
+        host_tracks = {ev["pid"] for ev in trace
+                       if ev.get("ph") != "M" and ev.get("pid", 0) >= 1}
+        span_gens = {ev.get("tid") for ev in trace
+                     if ev.get("cat") == "span"}
+        assert len(host_tracks) >= procs, (
+            f"fleet trace holds {len(host_tracks)} host tracks, "
+            f"wanted >= {procs}")
+        assert any(ev.get("name") == "generation_resize" for ev in trace), (
+            "no resize marker in the merged fleet trace")
+        assert len(span_gens) >= 2, (
+            f"span records did not straddle the resize: gens {span_gens}")
+        trace_stats = {
+            "events": len(trace),
+            "host_tracks": len(host_tracks),
+            "span_generations": sorted(span_gens),
+        }
 
     # the mechanisms must have actually engaged, each on its own side
     assert [r for r in el["resizes"] if r["kind"] == "shrink"
@@ -945,6 +1070,8 @@ def bench_faults_elastic(n_steps: int = 60, *, kill_step: int = 35,
             "recovery_speedup": round(
                 rs["recovery_latency_s"] / el["recovery_latency_s"], 3
             ) if el["recovery_latency_s"] > 0 else 0.0,
+            # merged chrome trace of the elastic run (scripts/fleet_trace.py)
+            "fleet_trace": trace_stats,
             **_anchor_fields(metric, el_frac),
         },
     })

@@ -390,6 +390,26 @@ def _launch_once(
     return rc, failure, first_dead, grew.is_set()
 
 
+def _metrics_port_base(train_args: list[str]) -> int | None:
+    """The children's ``--metrics_port`` base from forwarded train args
+    (rank i then listens on base + i — cli/train.py main), or None when
+    the run exposes no per-process metrics. Last occurrence wins, like
+    the child's absl parse."""
+    base = None
+    for i, a in enumerate(train_args):
+        if a.startswith("--metrics_port="):
+            val = a.split("=", 1)[1]
+        elif a == "--metrics_port" and i + 1 < len(train_args):
+            val = train_args[i + 1]
+        else:
+            continue
+        try:
+            base = int(val)
+        except ValueError:
+            continue
+    return base if base else None
+
+
 def launch(
     num_processes: int,
     train_args: list[str],
@@ -410,6 +430,7 @@ def launch(
     host_kill: tuple[int, float | None] | None = None,
     health=None,
     supervisor_port: int | None = None,
+    fleet_interval_s: float = 1.0,
 ) -> int:
     """Spawn the cluster; return 0 or a deterministic nonzero exit status
     (the first abnormal death's, signal deaths normalized to 128+N).
@@ -461,7 +482,15 @@ def launch(
     ``min_processes``, or any chief death, stays fatal. ``health`` (an
     obs.exporter.HealthState) tracks the supervisor itself — it reports
     ``resizing`` during mesh re-formation — and ``supervisor_port`` serves
-    it over /healthz (503 while resizing, so routers hold traffic)."""
+    it over /healthz (503 while resizing, so routers hold traffic).
+
+    When the forwarded train args include ``--metrics_port`` (so each
+    child rank exposes its own /metrics on base+rank), the supervisor
+    endpoint additionally runs a ``FleetScraper`` (obs/fleet.py):
+    /metrics grows merged fleet-wide histograms plus ``fleet/*`` gauges
+    (including straggler detection), and /fleet serves the per-host JSON
+    view. Scrape targets are re-pointed at every generation start, so
+    the fleet view follows resizes."""
     from dist_mnist_tpu.obs import events as events_mod
 
     if elastic and max_restarts <= 0:
@@ -503,15 +532,30 @@ def launch(
                   max_restarts=max_restarts, elastic=elastic)
     membership = Membership(num_processes) if elastic else None
     exporter = None
+    scraper = None
+    metrics_base = _metrics_port_base(train_args)
     if supervisor_port is not None and supervisor_port >= 0 and elastic:
         from dist_mnist_tpu.obs.exporter import HealthState, MetricsExporter
 
         if health is None:
             health = HealthState()
+        if metrics_base:
+            # children expose /metrics on metrics_base + rank: the fleet
+            # scraper merges them and the supervisor endpoint serves the
+            # fleet-wide view (/metrics merged series + /fleet JSON)
+            from dist_mnist_tpu.obs.fleet import FleetScraper
+
+            scraper = FleetScraper(journal=jrnl,
+                                   interval_s=fleet_interval_s).start()
         exporter = MetricsExporter(
-            health=health, journal_path=journal, port=supervisor_port
+            registry=scraper.registry if scraper is not None else None,
+            health=health, journal_path=journal, port=supervisor_port,
+            info={"role": "supervisor", "generation": 0},
+            fleet=scraper,
         ).start()
-        _say(f"[supervisor] health endpoint: {exporter.url('/healthz')}")
+        _say(f"[supervisor] health endpoint: {exporter.url('/healthz')}"
+             + (f" (fleet view: {exporter.url('/fleet')})"
+                if scraper is not None else ""))
     rng = random.Random(0)  # deterministic jitter (tests time the backoff)
     attempt = 0  # failure restarts/resizes consumed (bounded)
     gen = 0  # journal generation number (grows also advance it)
@@ -547,6 +591,16 @@ def launch(
                           hosts=hosts)
             if health is not None:
                 health.set("training", f"gen={gen} world={world}")
+            if scraper is not None and metrics_base:
+                # rank i listens on metrics_base + i and IS host hosts[i]
+                gen_hosts = hosts if hosts is not None \
+                    else list(range(world))
+                scraper.set_targets({
+                    h: f"http://127.0.0.1:{metrics_base + i}"
+                    for i, h in enumerate(gen_hosts)
+                })
+            if exporter is not None and exporter.info is not None:
+                exporter.info["generation"] = gen
             rc, failure, first_dead, grew = _launch_once(
                 world, train_args, port=port, platform=platform,
                 devices_per_process=devices_per_process,
@@ -636,6 +690,8 @@ def launch(
                           delay_s=round(delay, 3), failure=failure)
             time.sleep(delay)
     finally:
+        if scraper is not None:
+            scraper.close()
         if exporter is not None:
             exporter.close()
         if jrnl is not None:

@@ -107,6 +107,11 @@ def main(argv):
             exporter = MetricsExporter(
                 registry, health=health, journal_path=journal_path,
                 port=FLAGS.metrics_port,
+                info={
+                    "host_id": os.environ.get(events_mod.ENV_HOST_ID, "0"),
+                    "generation": str(health.generation),
+                    "role": "serve",
+                },
             ).start()
         except OSError as e:
             log.warning("metrics exporter: could not bind port %d (%s); "

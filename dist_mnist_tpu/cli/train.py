@@ -168,6 +168,21 @@ flags.DEFINE_integer("elastic_baseline_devices", 0,
                      "supervisor injects this); with a resized mesh the "
                      "elastic_batch_policy is applied against it and the "
                      "decision is journaled. 0 = not elastic")
+flags.DEFINE_integer("span_steps", 0,
+                     "correlated step tracing: every N steps, journal one "
+                     "`span` event per phase (input_wait / dispatch / h2d, "
+                     "plus checkpoint saves) stamped with the (host, "
+                     "generation, step) triple — scripts/fleet_trace.py "
+                     "merges them into a chrome://tracing file with one "
+                     "track per host. 0 = off")
+flags.DEFINE_boolean("anomaly", False,
+                     "in-loop anomaly detection (obs/anomaly.py): robust "
+                     "median/MAD detectors over loss and step time journal "
+                     "`anomaly` events and flip /healthz to the degraded "
+                     "(200-but-flagged) state; never alters the trajectory")
+flags.DEFINE_integer("anomaly_every", 25,
+                     "anomaly-check cadence in steps (one loss fetch per "
+                     "check — the NaNGuard sync budget)")
 
 
 def build_optimizer(cfg):
@@ -272,6 +287,9 @@ def _run_config(
     generation: int = 0,
     checkpoint_every_steps: int = 0,
     elastic_baseline_devices: int = 0,
+    span_steps: int = 0,
+    anomaly: bool = False,
+    anomaly_every: int = 25,
 ):
     """Implementation behind `run_config` (the public wrapper adds the
     PRNG-impl scope — call THAT, not this).
@@ -310,11 +328,22 @@ def _run_config(
                     if journal_obj is not None else None)
     exporter = None
     if metrics_port:
+        import os as _os
+
+        # identity labels: merged fleet series stay attributable to this
+        # process (host id is the supervisor-injected stable id; plain
+        # single-host runs are host 0)
+        proc_info = {
+            "host_id": _os.environ.get(events_mod.ENV_HOST_ID, "0"),
+            "generation": str(generation),
+            "role": "train",
+        }
         try:
             exporter = MetricsExporter(
                 registry, health=health,
                 journal_path=journal_obj.path if journal_obj else None,
                 port=metrics_port,
+                info=proc_info,
             ).start()
         except OSError as e:
             # exposition is an aid; a taken port must not kill training
@@ -335,6 +364,8 @@ def _run_config(
             registry=registry, health=health,
             checkpoint_every_steps=checkpoint_every_steps,
             elastic_baseline_devices=elastic_baseline_devices,
+            span_steps=span_steps, anomaly=anomaly,
+            anomaly_every=anomaly_every,
         )
         import jax as _jax
 
@@ -394,6 +425,9 @@ def _run_train(
     health=None,
     checkpoint_every_steps: int = 0,
     elastic_baseline_devices: int = 0,
+    span_steps: int = 0,
+    anomaly: bool = False,
+    anomaly_every: int = 25,
 ):
     """The training run itself (see `_run_config`, which wraps it in the
     observability scope and owns the exporter/journal lifecycles)."""
@@ -608,6 +642,14 @@ def _run_train(
             hooks_lib.MemoryHook(writer, every_steps=cfg.log_every),
             hooks_lib.NaNGuardHook(),
         ]
+        if anomaly:
+            from dist_mnist_tpu.obs.anomaly import AnomalyHook
+
+            # read-only by construction (docs/OBSERVABILITY.md "Fleet
+            # view"): journals anomalies and shades /healthz to degraded,
+            # trajectory stays bit-identical (bench.py --faults pins it)
+            hooks.append(AnomalyHook(every_steps=anomaly_every,
+                                     health=health))
         if overlap_cfg is not None:
             from dist_mnist_tpu.parallel.overlap import plan_stats
 
@@ -681,6 +723,7 @@ def _run_train(
             runahead=runahead,
             preemption=preemption,
             health=health,
+            span_steps=span_steps,
         )
         if registry is not None:
             # live full-distribution exposition of per-step wall time
@@ -856,6 +899,9 @@ def main(argv):
             generation=generation,
             checkpoint_every_steps=FLAGS.checkpoint_every_steps,
             elastic_baseline_devices=FLAGS.elastic_baseline_devices,
+            span_steps=FLAGS.span_steps,
+            anomaly=FLAGS.anomaly,
+            anomaly_every=FLAGS.anomaly_every,
         )
     finally:
         uninstall()

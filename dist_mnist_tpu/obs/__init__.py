@@ -21,6 +21,8 @@ from dist_mnist_tpu.obs.hist import StreamingHistogram
 from dist_mnist_tpu.obs.registry import MetricRegistry, RegistryWriter
 from dist_mnist_tpu.obs.exporter import HealthState, MetricsExporter
 from dist_mnist_tpu.obs.events import RunJournal
+from dist_mnist_tpu.obs.fleet import FleetScraper, parse_prometheus
+from dist_mnist_tpu.obs.anomaly import AnomalyHook, RobustDetector
 
 __all__ = [
     "MetricWriter",
@@ -38,4 +40,8 @@ __all__ = [
     "HealthState",
     "MetricsExporter",
     "RunJournal",
+    "FleetScraper",
+    "parse_prometheus",
+    "AnomalyHook",
+    "RobustDetector",
 ]

@@ -4,7 +4,12 @@ One journal file records a whole run — across processes and across
 supervisor restart generations. Every record is a single JSON line
 
     {"seq": n, "ts": <unix>, "pid": <pid>, "gen": <generation>,
-     "event": "<name>", ...fields}
+     "host": <host_id, when known>, "event": "<name>", ...fields}
+
+``host`` is the stable elastic-membership host id (set by the
+supervisor via ``DIST_MNIST_TPU_HOST_ID``): unlike ``pid`` it survives
+generation rollover, which is what lets scripts/fleet_trace.py keep one
+timeline track per host across a resize.
 
 ``seq`` is monotonic per (pid, generation); ``(pid, gen, seq)`` is a
 total order key within one process's lifetime. Writes go through an
@@ -34,22 +39,33 @@ log = logging.getLogger(__name__)
 __all__ = [
     "RunJournal", "set_journal", "get_journal", "emit",
     "read_journal", "tail_journal",
-    "ENV_JOURNAL", "ENV_GENERATION",
+    "ENV_JOURNAL", "ENV_GENERATION", "ENV_HOST_ID",
 ]
 
 # Env vars the supervisor sets so every child generation lands in the
 # supervisor-owned journal (mirrors the --compile_cache_dir injection).
 ENV_JOURNAL = "DIST_MNIST_TPU_JOURNAL"
 ENV_GENERATION = "DIST_MNIST_TPU_GENERATION"
+# Stable host identity across generations. Defined (with the same
+# value) in cluster/membership.py; duplicated here so the journal
+# stays importable without pulling the cluster package.
+ENV_HOST_ID = "DIST_MNIST_TPU_HOST_ID"
 
 
 class RunJournal:
     """Append-only JSONL event sink. Thread-safe; multi-process-safe on
     POSIX for records under PIPE_BUF (ours are tiny)."""
 
-    def __init__(self, path, *, generation: int = 0):
+    def __init__(self, path, *, generation: int = 0,
+                 host_id: int | None = None):
         self.path = os.fspath(path)
         self.generation = int(generation)
+        if host_id is None:
+            env_host = os.environ.get(ENV_HOST_ID)
+            host_id = int(env_host) if env_host is not None else None
+        # stable host id (survives generation rollover); None for
+        # single-process runs and the supervisor itself
+        self.host_id = host_id
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -62,6 +78,8 @@ class RunJournal:
     def emit(self, event: str, **fields) -> dict:
         rec = {"seq": 0, "ts": time.time(), "pid": os.getpid(),
                "gen": self.generation, "event": str(event)}
+        if self.host_id is not None:
+            rec["host"] = self.host_id
         rec.update(fields)
         with self._lock:
             if self._closed:

@@ -9,17 +9,21 @@ each GET — no background sampling loop, nothing to fall behind.
 Health is a tiny explicit state machine rather than a boolean:
 
     starting -> training | serving -> draining | preempted -> stopped
-                                   -> resizing               | failed
+                       `-> degraded  -> resizing               | failed
 
 ``/healthz`` returns 200 while the process is doing useful work
-(starting/training/serving) and 503 otherwise, so a fleet router can
-stop sending traffic to a draining replica before it disappears
-(ROADMAP "replica health/drain integration with the supervisor").
-``resizing`` is the elastic supervisor's mesh re-formation window
-(cli/launch.py --elastic, docs/RESILIENCE.md "Elastic generations"): a
-membership change was decided and the next generation has not started
-yet — deliberately NOT healthy, so routers hold traffic exactly like a
-drain.
+(starting/training/serving/degraded) and 503 otherwise, so a fleet
+router can stop sending traffic to a draining replica before it
+disappears (ROADMAP "replica health/drain integration with the
+supervisor"). ``resizing`` is the elastic supervisor's mesh
+re-formation window (cli/launch.py --elastic, docs/RESILIENCE.md
+"Elastic generations"): a membership change was decided and the next
+generation has not started yet — deliberately NOT healthy, so routers
+hold traffic exactly like a drain. ``degraded`` is the anomaly
+detector's 200-but-flagged state (obs/anomaly.py): the process is
+still making progress — killing or rerouting it would cost more than
+the anomaly — but operators and the fleet scraper can see the flag in
+the /healthz body and in ``process_state{state="degraded"}``.
 
 Threads are named ``ObsExporter*`` and live exporters are tracked in
 ``_LIVE_EXPORTERS`` so the conftest leak-check can prove every test
@@ -44,9 +48,9 @@ __all__ = ["HealthState", "MetricsExporter"]
 # conftest leak registry: every started-but-unclosed exporter is a leak.
 _LIVE_EXPORTERS: list = []
 
-_HEALTHY = frozenset({"starting", "training", "serving"})
+_HEALTHY = frozenset({"starting", "training", "serving", "degraded"})
 _STATES = frozenset(
-    {"starting", "training", "serving", "draining", "resizing",
+    {"starting", "training", "serving", "degraded", "draining", "resizing",
      "preempted", "stopped", "failed"})
 
 
@@ -113,9 +117,35 @@ def _prom_value(v: float) -> str:
     return repr(float(v))
 
 
-def render_prometheus(registry, health: HealthState | None = None) -> str:
+def render_histogram_lines(name: str, hist) -> list[str]:
+    """Prometheus text lines for one StreamingHistogram (cumulative
+    buckets, ``_sum``/``_count``). Shared by the per-process exporter
+    and the fleet scraper's merged view (obs/fleet.py)."""
+    lines = [f"# TYPE {name} histogram"]
+    cum = 0
+    for edge, count in hist.buckets():
+        # the overflow bucket IS le="+Inf"; the explicit total
+        # line below covers it (emitting both would duplicate
+        # the series)
+        if count == 0 or math.isinf(edge):
+            continue
+        cum += count
+        lines.append(f'{name}_bucket{{le="{repr(float(edge))}"}} {cum}')
+    lines.append(f'{name}_bucket{{le="+Inf"}} {hist.count}')
+    lines.append(f"{name}_sum {_prom_value(hist.sum)}")
+    lines.append(f"{name}_count {hist.count}")
+    return lines
+
+
+def render_prometheus(registry, health: HealthState | None = None,
+                      info: dict | None = None) -> str:
     """Render the registry (and health, as ``up``-style gauges) in
-    Prometheus text exposition format."""
+    Prometheus text exposition format.
+
+    ``info`` is an optional identity-label dict (host_id / generation /
+    role) rendered as a constant ``process_info{...} 1`` info-gauge so
+    merged fleet series stay attributable to their source process.
+    """
     lines: list[str] = []
     if registry is not None:
         for tag, (value, step, _wall) in sorted(registry.scalars().items()):
@@ -123,21 +153,12 @@ def render_prometheus(registry, health: HealthState | None = None) -> str:
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {_prom_value(value)}")
         for tag, hist in sorted(registry.histograms().items()):
-            name = _prom_name(tag)
-            lines.append(f"# TYPE {name} histogram")
-            cum = 0
-            for edge, count in hist.buckets():
-                # the overflow bucket IS le="+Inf"; the explicit total
-                # line below covers it (emitting both would duplicate
-                # the series)
-                if count == 0 or math.isinf(edge):
-                    continue
-                cum += count
-                lines.append(
-                    f'{name}_bucket{{le="{repr(float(edge))}"}} {cum}')
-            lines.append(f'{name}_bucket{{le="+Inf"}} {hist.count}')
-            lines.append(f"{name}_sum {_prom_value(hist.sum)}")
-            lines.append(f"{name}_count {hist.count}")
+            lines.extend(render_histogram_lines(_prom_name(tag), hist))
+    if info:
+        labels = ",".join(
+            f'{_prom_name(k)}="{v}"' for k, v in sorted(info.items()))
+        lines.append("# TYPE process_info gauge")
+        lines.append(f"process_info{{{labels}}} 1")
     if health is not None:
         snap = health.snapshot()
         lines.append("# TYPE process_healthy gauge")
@@ -169,8 +190,19 @@ class _Handler(BaseHTTPRequestHandler):
             url = urlparse(self.path)
             exp = self.exporter
             if url.path == "/metrics":
-                body = render_prometheus(exp.registry, exp.health)
+                body = render_prometheus(exp.registry, exp.health,
+                                         info=exp.info)
+                if exp.fleet is not None:
+                    body += exp.fleet.render_prometheus()
                 self._send(200, body, "text/plain; version=0.0.4")
+            elif url.path == "/fleet":
+                if exp.fleet is None:
+                    self._send(404, "no fleet scraper attached\n",
+                               "text/plain")
+                    return
+                self._send(200,
+                           json.dumps(exp.fleet.snapshot(), sort_keys=True),
+                           "application/json")
             elif url.path == "/healthz":
                 if exp.health is None:
                     self._send(200, json.dumps({"state": "unknown"}),
@@ -206,10 +238,16 @@ class MetricsExporter:
     """Background /metrics + /healthz + /events server for one process."""
 
     def __init__(self, registry=None, *, health: HealthState | None = None,
-                 journal_path=None, port: int = 0, host: str = "127.0.0.1"):
+                 journal_path=None, port: int = 0, host: str = "127.0.0.1",
+                 info: dict | None = None, fleet=None):
         self.registry = registry
         self.health = health
         self.journal_path = journal_path
+        # identity labels (host_id/generation/role) -> process_info gauge
+        self.info = dict(info) if info else None
+        # optional obs/fleet.FleetScraper: merged fleet series on /metrics
+        # plus the /fleet JSON endpoint
+        self.fleet = fleet
         self.host = host
         self.port = int(port)
         self._server: ThreadingHTTPServer | None = None

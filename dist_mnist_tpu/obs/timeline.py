@@ -14,16 +14,26 @@ close the gap to the reference's UX:
   the reference emitted, loadable in chrome://tracing / perfetto.
 - `summarize_trace(path, top)` -> top-N ops by self device time, for triage
   on machines with no TensorBoard reachable (this box: zero egress).
+
+On a shared logdir, multiple hosts profile into the same
+`plugins/profile` tree; exports are therefore stamped with the host id
+(`timeline-<host>-<run>.json`, host from `DIST_MNIST_TPU_HOST_ID`) so
+one host's export can never shadow another's, and
+scripts/fleet_trace.py can merge them back into one per-host-track
+fleet trace.
 """
 
 from __future__ import annotations
 
 import gzip
 import json
+import os
 from collections import defaultdict
 from pathlib import Path
 
 __all__ = ["latest_trace", "export_chrome_trace", "summarize_trace"]
+
+_ENV_HOST_ID = "DIST_MNIST_TPU_HOST_ID"  # == obs/events.ENV_HOST_ID
 
 
 def latest_trace(logdir: str | Path) -> Path | None:
@@ -36,17 +46,26 @@ def latest_trace(logdir: str | Path) -> Path | None:
 
 
 def export_chrome_trace(
-    logdir: str | Path, out_path: str | Path | None = None
+    logdir: str | Path, out_path: str | Path | None = None,
+    host_id: int | str | None = None,
 ) -> Path | None:
-    """Decompress the latest profiler trace to `timeline-<run>.json`.
+    """Decompress the latest profiler trace to
+    `timeline-<host>-<run>.json` (`timeline-<run>.json` when no host
+    identity is known — single-process runs).
 
     Returns the written path, or None when no trace exists yet. Naming
-    mirrors the reference's `timeline-<step>.json` files."""
+    mirrors the reference's `timeline-<step>.json` files; the host stamp
+    keeps concurrent hosts on a shared logdir from shadowing each
+    other's export."""
     src = latest_trace(logdir)
     if src is None:
         return None
+    if host_id is None:
+        host_id = os.environ.get(_ENV_HOST_ID)
     if out_path is None:
-        out_path = Path(logdir) / f"timeline-{src.parent.name}.json"
+        stem = (f"timeline-h{host_id}-{src.parent.name}"
+                if host_id is not None else f"timeline-{src.parent.name}")
+        out_path = Path(logdir) / f"{stem}.json"
     out_path = Path(out_path)
     out_path.write_bytes(gzip.decompress(src.read_bytes()))
     return out_path
@@ -60,6 +79,11 @@ def summarize_trace(
     Works on either the raw `.trace.json.gz` or an exported timeline JSON.
     Returns rows sorted by total time, descending:
     `{"name", "total_us", "count", "avg_us"}`.
+
+    Tolerant of sparse producers: events missing `pid`/`tid`/`name` or
+    carrying a non-numeric `dur` (hand-built traces, fleet_trace merges,
+    other profilers) are aggregated under defaults or skipped rather
+    than raising.
     """
     raw = Path(trace_path).read_bytes()
     if str(trace_path).endswith(".gz"):
@@ -67,11 +91,19 @@ def summarize_trace(
     events = json.loads(raw).get("traceEvents", [])
     total = defaultdict(float)
     count = defaultdict(int)
+    tracks = defaultdict(set)
     for ev in events:
-        if ev.get("ph") == "X" and "dur" in ev:
-            name = ev.get("name", "?")
-            total[name] += ev["dur"]
-            count[name] += 1
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        dur = ev.get("dur")
+        if not isinstance(dur, (int, float)):
+            continue
+        name = ev.get("name", "?")
+        total[name] += dur
+        count[name] += 1
+        # pid/tid are optional per the trace-format spec: default, never
+        # index, so partial producers summarize instead of crash
+        tracks[name].add((ev.get("pid", 0), ev.get("tid", 0)))
     rows = sorted(total, key=total.__getitem__, reverse=True)[:top]
     return [
         {
@@ -79,6 +111,7 @@ def summarize_trace(
             "total_us": round(total[n], 1),
             "count": count[n],
             "avg_us": round(total[n] / count[n], 2),
+            "tracks": len(tracks[n]),
         }
         for n in rows
     ]

@@ -99,6 +99,7 @@ class TrainLoop:
         runahead: int = 0,
         preemption=None,
         health=None,
+        span_steps: int = 0,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -141,9 +142,37 @@ class TrainLoop:
         self._host_step = self.initial_step  # host mirror of state.step:
         # tracks the global step without a device sync per step
         self._first_step_emitted = False  # first_step journal latch
+        # correlated step tracing: every `span_steps` steps, journal one
+        # `span` event per phase (input_wait / dispatch / h2d) with the
+        # step's host-side timings. The (host, gen, step) triple the
+        # journal stamps makes the spans line up across hosts in
+        # scripts/fleet_trace.py. 0 = off; timings come from clocks the
+        # loop already reads, so the gate costs nothing when idle.
+        self.span_steps = int(span_steps)
+        self._next_span = (self.initial_step + self.span_steps
+                           if self.span_steps else None)
+        self._h2d_base = 0
 
     def request_stop(self, reason: str | None = None) -> None:
         self.stop.request_stop(reason)
+
+    def _emit_spans(self, dt_feed: float, dt_step: float) -> None:
+        """One sampled step's phase spans into the journal. `dur_ms`
+        spans become chrome-trace complete events (start reconstructed
+        as ts - dur); the h2d span has no duration signal — only the
+        byte counter from the prefetch ring — so it journals as a
+        counter sample and renders as an instant."""
+        step = self._host_step
+        events.emit("span", name="input_wait", step=step,
+                    dur_ms=round(dt_feed * 1e3, 3))
+        events.emit("span", name="dispatch", step=step,
+                    dur_ms=round(dt_step * 1e3, 3))
+        stats_fn = getattr(self.batches, "stats", None)
+        if callable(stats_fn):
+            h2d = int(stats_fn().get("h2d_bytes", 0))
+            base, self._h2d_base = self._h2d_base, h2d
+            events.emit("span", name="h2d", step=step,
+                        bytes=max(0, h2d - base))
 
     def _honor_preemption(self) -> None:
         """Consume a preemption notice at a step boundary: persist state
@@ -233,6 +262,10 @@ class TrainLoop:
                     # per-STEP wall time even when step_fn runs a chunk
                     self.step_time_hist.observe(
                         dt_step * 1e3 / self.steps_per_call)
+                    if (self._next_span is not None
+                            and self._host_step >= self._next_span):
+                        self._next_span = self._host_step + self.span_steps
+                        self._emit_spans(dt_feed, dt_step)
                     if g.in_replay:
                         # catching back up to the pre-failure step: correct
                         # work, but no NEW progress — charged to replay, and

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Gate on bench regressions: newest BENCH_*.json vs docs/PERF_ANCHOR.json.
+
+bench.py stamps `vs_anchor` (measured / last-committed-anchor ratio) on
+its one-line JSON report whenever the running chip's device_kind matches
+the anchor's. This script turns that number into a pass/fail:
+
+    exit 1  -- a metric's vs_anchor fell below 1 - tolerance (regression)
+    exit 0  -- everything within tolerance, OR nothing checkable: no
+               BENCH_*.json, no anchor file, bench errored (backend
+               down), or hardware mismatch (no vs_anchor). Skips are
+               loud on stdout but never fail the build — this box may
+               have no accelerator at all.
+
+Tolerance is 0.15 by default (steps/sec is noisy at small step counts;
+docs/PERF.md), overridable per metric with a `tolerance` key on the
+anchor entry, and globally with --tolerance. Improvements (vs_anchor
+well above 1.0) are reported, never failed — update the anchor instead.
+
+    python scripts/check_bench_regression.py            # repo-root scan
+    python scripts/check_bench_regression.py --tolerance 0.05
+
+Stdlib-only and fast (no jax import): tests/test_bench_regression.py
+runs the `check()` entry point inside tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DEFAULT_TOLERANCE = 0.15
+
+
+def newest_bench(root: str | Path = REPO) -> Path | None:
+    """Newest BENCH_*.json by round number (BENCH_r05 > BENCH_r04), falling
+    back to mtime when the name carries no ordering."""
+    found = sorted(Path(root).glob("BENCH_*.json"),
+                   key=lambda p: (p.name, p.stat().st_mtime))
+    return found[-1] if found else None
+
+
+def bench_records(path: str | Path) -> list[dict]:
+    """Extract bench report lines from a BENCH_*.json driver artifact.
+
+    The artifact wraps bench.py's stdout: `parsed` holds the last JSON
+    line, `tail` the raw text (possibly several lines when a battery
+    ran). Collect every metric-shaped record, last occurrence wins."""
+    try:
+        doc = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return []
+    if not isinstance(doc, dict):
+        return []
+    by_metric: dict[str, dict] = {}
+    for raw in str(doc.get("tail", "")).splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            by_metric[rec["metric"]] = rec
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        by_metric.setdefault(parsed["metric"], parsed)
+    return list(by_metric.values())
+
+
+def load_anchors(path: str | Path) -> dict[str, dict]:
+    try:
+        doc = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return {}
+    return {k: v for k, v in doc.items()
+            if isinstance(v, dict) and not k.startswith("_")}
+
+
+def check(
+    bench_path: str | Path | None = None,
+    anchor_path: str | Path | None = None,
+    tolerance: float = DEFAULT_TOLERANCE,
+) -> tuple[bool, list[dict]]:
+    """Returns (ok, report_rows). ok is False only on a real regression.
+
+    Each row: {"metric", "status": regression|ok|improved|skip,
+    "detail", and vs_anchor/floor when checked}."""
+    if bench_path is None:
+        bench_path = newest_bench()
+    if anchor_path is None:
+        anchor_path = REPO / "docs" / "PERF_ANCHOR.json"
+    rows: list[dict] = []
+    if bench_path is None or not Path(bench_path).exists():
+        return True, [{"metric": "*", "status": "skip",
+                       "detail": "no BENCH_*.json artifact found"}]
+    anchors = load_anchors(anchor_path)
+    if not anchors:
+        return True, [{"metric": "*", "status": "skip",
+                       "detail": f"no anchors readable at {anchor_path}"}]
+    records = bench_records(bench_path)
+    if not records:
+        return True, [{"metric": "*", "status": "skip",
+                       "detail": f"no bench records in {bench_path}"}]
+    ok = True
+    for rec in records:
+        metric = rec["metric"]
+        if rec.get("error"):
+            rows.append({"metric": metric, "status": "skip",
+                         "detail": f"bench errored: {rec['error']}"})
+            continue
+        vs = rec.get("vs_anchor")
+        if not isinstance(vs, (int, float)):
+            rows.append({"metric": metric, "status": "skip",
+                         "detail": "no vs_anchor (hardware mismatch or "
+                                   "unanchored metric)"})
+            continue
+        tol = anchors.get(metric, {}).get("tolerance", tolerance)
+        floor = 1.0 - float(tol)
+        row = {"metric": metric, "vs_anchor": round(float(vs), 3),
+               "floor": round(floor, 3)}
+        if vs < floor:
+            ok = False
+            row.update(status="regression",
+                       detail=f"vs_anchor {vs:.3f} < floor {floor:.3f}")
+        elif vs > 1.0 + float(tol):
+            row.update(status="improved",
+                       detail=f"vs_anchor {vs:.3f}; consider re-anchoring "
+                              "(docs/PERF.md)")
+        else:
+            row.update(status="ok", detail=f"vs_anchor {vs:.3f}")
+        rows.append(row)
+    return ok, rows
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fail when the newest BENCH_*.json regressed vs "
+                    "docs/PERF_ANCHOR.json")
+    parser.add_argument("--bench", default=None,
+                        help="BENCH_*.json path (default: newest in repo root)")
+    parser.add_argument("--anchor", default=None,
+                        help="anchor file (default docs/PERF_ANCHOR.json)")
+    parser.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                        help="allowed fractional drop below the anchor "
+                             "(default 0.15; per-metric `tolerance` keys in "
+                             "the anchor file override)")
+    args = parser.parse_args(argv)
+    ok, rows = check(args.bench, args.anchor, args.tolerance)
+    for row in rows:
+        print(f"check_bench_regression: {row['metric']}: {row['status']} "
+              f"({row['detail']})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Merge a fleet's run journal + per-host timeline exports into ONE
+chrome://tracing file with one track per host.
+
+The run journal (obs/events.py) is host-stamped: every record carries
+`host` (from DIST_MNIST_TPU_HOST_ID) and `gen`, and the train loop emits
+cadence-gated `span` records (input_wait / h2d / dispatch / checkpoint)
+with wall-clock timestamps. Those three coordinates — (host, gen, step)
+— are exactly what chrome trace needs:
+
+    pid = host + 1      (track per host; supervisor-side records on pid 0)
+    tid = generation    (a resize shows up as the work hopping threads)
+    ts  = journal wall clock, rebased to the earliest record
+
+`span` records with `dur_ms` become complete events (ph "X") ending at
+their journal timestamp; spans without a duration (h2d carries bytes,
+not time) and every lifecycle record (generation_resize, preemption,
+straggler_detected, anomaly, checkpoint_*) become instants (ph "i"), so
+the resize/fault story lines up against the per-host step work.
+
+Per-host profiler exports (obs/timeline.py `timeline-h<host>-<run>.json`)
+can be merged in with --timelines: their events keep their internal
+structure but are remapped onto fresh pids grouped under the owning
+host's name. Profiler clocks are per-process, not fleet-aligned, so each
+file is rebased to its own start rather than the journal's.
+
+    python scripts/fleet_trace.py /tmp/run/journal.jsonl -o fleet.json
+    python scripts/fleet_trace.py j.jsonl --timelines /tmp/run/logs
+
+Stdlib-only on purpose: runs on a machine that has the artifacts but
+not jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+#: journal keys not worth repeating inside trace-event args
+_SKIP_ARGS = ("seq", "ts", "pid", "gen", "event", "host", "name", "dur_ms")
+
+#: timeline export filename -> host id ("timeline-h3-run.json" -> 3)
+_TIMELINE_RE = re.compile(r"^timeline-h(\d+)-.*\.json$")
+
+
+def _read_journal(path: str | Path) -> list[dict]:
+    recs = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and isinstance(rec.get("ts"), (int, float)):
+                recs.append(rec)
+    return recs
+
+
+def _pid_for(rec: dict) -> int:
+    host = rec.get("host")
+    try:
+        return int(host) + 1
+    except (TypeError, ValueError):
+        return 0  # supervisor / pre-fleet records
+
+
+def journal_events(recs: list[dict]) -> list[dict]:
+    """Journal records -> trace events (no metadata; see build_fleet_trace)."""
+    if not recs:
+        return []
+    base = min(r["ts"] for r in recs)
+    out = []
+    for rec in recs:
+        pid = _pid_for(rec)
+        tid = rec.get("gen", 0)
+        ts_us = (rec["ts"] - base) * 1e6
+        args = {k: v for k, v in rec.items()
+                if k not in _SKIP_ARGS and v is not None}
+        event = rec.get("event", "?")
+        if event == "span" and isinstance(rec.get("dur_ms"), (int, float)):
+            dur_us = rec["dur_ms"] * 1e3
+            out.append({
+                "name": rec.get("name", "span"), "ph": "X", "cat": "span",
+                # the journal stamps span END (emit happens after the work);
+                # rebuild the start so the bar covers the right interval
+                "ts": round(max(0.0, ts_us - dur_us), 3),
+                "dur": round(dur_us, 3),
+                "pid": pid, "tid": tid, "args": args,
+            })
+        elif event == "span":
+            out.append({
+                "name": rec.get("name", "span"), "ph": "i", "s": "t",
+                "cat": "span", "ts": round(ts_us, 3),
+                "pid": pid, "tid": tid, "args": args,
+            })
+        else:
+            out.append({
+                "name": event, "ph": "i", "s": "p", "cat": "lifecycle",
+                "ts": round(ts_us, 3), "pid": pid, "tid": tid, "args": args,
+            })
+    return out
+
+
+def _merge_timeline(path: Path, host: int | None, next_pid: int) -> tuple[list[dict], int]:
+    """Remap one profiler export onto fresh pids; returns (events, next_pid)."""
+    try:
+        events = json.loads(path.read_bytes()).get("traceEvents", [])
+    except (OSError, ValueError):
+        return [], next_pid
+    pid_map: dict = {}
+    times = [ev.get("ts") for ev in events
+             if isinstance(ev, dict) and isinstance(ev.get("ts"), (int, float))]
+    base = min(times) if times else 0.0
+    label = f"host {host}" if host is not None else path.stem
+    out = []
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        orig = ev.get("pid", 0)
+        if orig not in pid_map:
+            pid_map[orig] = next_pid
+            next_pid += 1
+            out.append({"name": "process_name", "ph": "M",
+                        "pid": pid_map[orig],
+                        "args": {"name": f"{label} profiler/{orig}"}})
+        ev = dict(ev)
+        ev["pid"] = pid_map[orig]
+        if isinstance(ev.get("ts"), (int, float)):
+            ev["ts"] = round(ev["ts"] - base, 3)
+        out.append(ev)
+    return out, next_pid
+
+
+def find_timelines(root: str | Path) -> list[tuple[Path, int | None]]:
+    """timeline-h<host>-*.json under root (recursively), host parsed from
+    the name; legacy un-stamped timeline-*.json files ride along with
+    host=None."""
+    found = []
+    for p in sorted(Path(root).rglob("timeline-*.json")):
+        m = _TIMELINE_RE.match(p.name)
+        found.append((p, int(m.group(1)) if m else None))
+    return found
+
+
+def build_fleet_trace(
+    journal: str | Path | None = None,
+    timelines: list[tuple[Path, int | None]] | None = None,
+) -> dict:
+    """Assemble the merged trace document. Importable for tests/bench."""
+    events: list[dict] = []
+    pids: set[int] = set()
+    if journal is not None:
+        jevents = journal_events(_read_journal(journal))
+        pids = {ev["pid"] for ev in jevents}
+        for pid in sorted(pids):
+            name = "supervisor" if pid == 0 else f"host {pid - 1}"
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "args": {"name": name}})
+            events.append({"name": "process_sort_index", "ph": "M",
+                           "pid": pid, "args": {"sort_index": pid}})
+        events.extend(jevents)
+    next_pid = (max(pids) if pids else 0) + 1000
+    for path, host in (timelines or []):
+        merged, next_pid = _merge_timeline(Path(path), host, next_pid)
+        events.extend(merged)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="merge a run journal + per-host timelines into one "
+                    "chrome://tracing file (one track per host)")
+    parser.add_argument("journal", nargs="?",
+                        help="path to the JSONL run journal")
+    parser.add_argument("--timelines", default=None, metavar="DIR",
+                        help="directory scanned (recursively) for "
+                             "timeline-h<host>-*.json profiler exports")
+    parser.add_argument("-o", "--out", default="fleet_trace.json",
+                        help="output path (default fleet_trace.json)")
+    args = parser.parse_args(argv)
+    if not args.journal and not args.timelines:
+        parser.error("need a journal and/or --timelines")
+    timelines = find_timelines(args.timelines) if args.timelines else []
+    try:
+        doc = build_fleet_trace(args.journal, timelines)
+    except OSError as e:
+        print(f"fleet_trace: {e}", file=sys.stderr)
+        return 1
+    Path(args.out).write_text(json.dumps(doc), encoding="utf-8")
+    tracks = {ev["pid"] for ev in doc["traceEvents"] if "pid" in ev}
+    print(f"fleet_trace: {len(doc['traceEvents'])} events across "
+          f"{len(tracks)} tracks -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,12 +22,20 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from datetime import datetime
 
 #: record keys already rendered in the fixed columns
 _FIXED = ("seq", "ts", "pid", "gen", "event")
+
+
+def _fmt_num(v, suffix: str = "") -> str:
+    try:
+        return f"{float(v):.2f}{suffix}"
+    except (TypeError, ValueError):
+        return f"{v}{suffix}"
 
 
 def format_record(rec: dict) -> str:
@@ -47,10 +55,38 @@ def format_record(rec: dict) -> str:
         head = (f"{rec.get('kind', '?')} {rec.get('old_world', '?')}->"
                 f"{rec.get('new_world', '?')} host={rec.get('host', '?')} ")
         skip = _FIXED + ("kind", "old_world", "new_world", "host")
+    elif event == "straggler_detected":
+        # Fleet scraper flagged a host: lead with who and how far behind.
+        head = (f"host={rec.get('host', '?')} "
+                f"{_fmt_num(rec.get('ratio'), 'x')} median "
+                f"({_fmt_num(rec.get('step_time_mean_ms'), 'ms')} vs "
+                f"{_fmt_num(rec.get('fleet_median_ms'), 'ms')}) ")
+        skip = _FIXED + ("host", "ratio", "step_time_mean_ms",
+                         "fleet_median_ms")
+    elif event == "anomaly":
+        head = (f"{rec.get('kind', '?')} "
+                f"z={_fmt_num(rec.get('zscore'))} ")
+        skip = _FIXED + ("kind", "zscore")
+    elif event == "span":
+        if rec.get("dur_ms") is not None:
+            head = f"{rec.get('name', '?')} {_fmt_num(rec['dur_ms'], 'ms')} "
+            skip = _FIXED + ("name", "dur_ms")
+        else:
+            head = f"{rec.get('name', '?')} "
+            skip = _FIXED + ("name",)
+    # journal records are host-stamped when DIST_MNIST_TPU_HOST_ID was set
+    # in the emitting process; fold that into the fixed columns so merged
+    # fleet journals stay scannable. generation_resize keeps its own
+    # host field (the host that left), rendered in the head above.
+    hostcol = ""
+    if "host" in rec and "host" not in skip:
+        hostcol = f"h{rec['host']}  "
+        skip = skip + ("host",)
     extras = " ".join(
         f"{k}={rec[k]}" for k in rec if k not in skip and rec[k] is not None
     )
-    return f"{clock}  g{gen}  {pid:>7}  {event:<20} {head}{extras}".rstrip()
+    return (f"{clock}  g{gen}  {hostcol}{pid:>7}  {event:<20} "
+            f"{head}{extras}").rstrip()
 
 
 def render_line(raw: str) -> str | None:
@@ -81,7 +117,7 @@ def main(argv: list[str] | None = None) -> int:
     except OSError as e:
         print(f"tail_run: {e}", file=sys.stderr)
         return 1
-    with fh:
+    try:
         lines = fh.readlines()
         if args.n > 0:
             lines = lines[-args.n:]
@@ -91,17 +127,34 @@ def main(argv: list[str] | None = None) -> int:
                 print(out)
         if not args.follow:
             return 0
+        # --follow must survive generation rollover: an elastic supervisor
+        # (or log rotation) can replace or truncate the journal under us.
+        # Detect inode change / shrink by stat()ing the path and reopen.
         try:
+            ino = os.fstat(fh.fileno()).st_ino
             while True:
                 raw = fh.readline()
-                if not raw:
-                    time.sleep(0.25)
+                if raw:
+                    out = render_line(raw)
+                    if out:
+                        print(out, flush=True)
                     continue
-                out = render_line(raw)
-                if out:
-                    print(out, flush=True)
+                time.sleep(0.25)
+                try:
+                    st = os.stat(args.journal)
+                except OSError:
+                    continue  # mid-rotation; keep the old fd until it's back
+                if st.st_ino != ino or st.st_size < fh.tell():
+                    fh.close()
+                    try:
+                        fh = open(args.journal, "r", encoding="utf-8")
+                    except OSError:
+                        continue
+                    ino = os.fstat(fh.fileno()).st_ino
         except KeyboardInterrupt:
             return 0
+    finally:
+        fh.close()
 
 
 if __name__ == "__main__":

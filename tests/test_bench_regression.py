@@ -1,0 +1,126 @@
+"""scripts/check_bench_regression.py: the tier-1 perf gate over
+BENCH_*.json driver artifacts vs docs/PERF_ANCHOR.json."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+sys.path.insert(0, str(REPO / "scripts"))
+try:
+    from check_bench_regression import (
+        DEFAULT_TOLERANCE,
+        bench_records,
+        check,
+        main,
+        newest_bench,
+    )
+finally:
+    sys.path.pop(0)
+
+
+def _bench(tmp_path, name="BENCH_r01.json", *, tail_recs=(), parsed=None):
+    path = tmp_path / name
+    doc = {"tail": "\n".join(json.dumps(r) for r in tail_recs)}
+    if parsed is not None:
+        doc["parsed"] = parsed
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def _anchor(tmp_path, entries):
+    path = tmp_path / "PERF_ANCHOR.json"
+    path.write_text(json.dumps({"_comment": "test", **entries}))
+    return path
+
+
+def test_regression_fails_below_floor(tmp_path):
+    bench = _bench(tmp_path, tail_recs=[
+        {"metric": "m1", "value": 80.0, "vs_anchor": 0.80}])
+    anchor = _anchor(tmp_path, {"m1": {"value": 100.0}})
+    ok, rows = check(bench, anchor)
+    assert not ok
+    assert rows[0]["status"] == "regression"
+    assert rows[0]["floor"] == pytest.approx(1 - DEFAULT_TOLERANCE)
+    assert main([f"--bench={bench}", f"--anchor={anchor}"]) == 1
+
+
+def test_within_tolerance_and_per_metric_override(tmp_path):
+    bench = _bench(tmp_path, tail_recs=[
+        {"metric": "m1", "value": 95.0, "vs_anchor": 0.95},
+        # 40% down but this metric declares a wider tolerance
+        {"metric": "m2", "value": 6.0, "vs_anchor": 0.60},
+    ])
+    anchor = _anchor(tmp_path, {
+        "m1": {"value": 100.0},
+        "m2": {"value": 10.0, "tolerance": 0.5},
+    })
+    ok, rows = check(bench, anchor)
+    assert ok
+    assert {r["metric"]: r["status"] for r in rows} == {"m1": "ok",
+                                                        "m2": "ok"}
+    assert main([f"--bench={bench}", f"--anchor={anchor}"]) == 0
+
+
+def test_improvement_never_fails(tmp_path):
+    bench = _bench(tmp_path, tail_recs=[
+        {"metric": "m1", "value": 200.0, "vs_anchor": 2.0}])
+    anchor = _anchor(tmp_path, {"m1": {"value": 100.0}})
+    ok, rows = check(bench, anchor)
+    assert ok and rows[0]["status"] == "improved"
+
+
+def test_clean_skips(tmp_path):
+    """No artifact, no anchor, bench error, no vs_anchor: all exit 0."""
+    anchor = _anchor(tmp_path, {"m1": {"value": 100.0}})
+    # bench errored (backend down): vs_anchor absent, error present
+    bench = _bench(tmp_path, parsed={
+        "metric": "m1", "value": 0.0, "error": "backend probe failed"})
+    ok, rows = check(bench, anchor)
+    assert ok and rows[0]["status"] == "skip"
+    # hardware mismatch: a record with no vs_anchor at all
+    bench2 = _bench(tmp_path, "BENCH_r02.json",
+                    tail_recs=[{"metric": "m1", "value": 50.0}])
+    ok, rows = check(bench2, anchor)
+    assert ok and rows[0]["status"] == "skip"
+    # missing anchor file
+    ok, rows = check(bench2, tmp_path / "absent.json")
+    assert ok and rows[0]["status"] == "skip"
+    # no bench artifact anywhere
+    ok, rows = check(tmp_path / "absent_bench.json", anchor)
+    assert ok and rows[0]["status"] == "skip"
+
+
+def test_newest_bench_prefers_latest_round(tmp_path):
+    for name in ("BENCH_r01.json", "BENCH_r03.json", "BENCH_r02.json"):
+        (tmp_path / name).write_text("{}")
+    assert newest_bench(tmp_path).name == "BENCH_r03.json"
+    assert newest_bench(tmp_path / "empty") is None
+
+
+def test_bench_records_merges_tail_and_parsed(tmp_path):
+    bench = _bench(
+        tmp_path,
+        tail_recs=[{"metric": "m1", "vs_anchor": 0.5},
+                   {"metric": "m1", "vs_anchor": 0.9},  # last wins
+                   {"metric": "m2", "vs_anchor": 1.0},
+                   {"not_a_metric": True}],
+        parsed={"metric": "m3", "vs_anchor": 1.1},
+    )
+    recs = {r["metric"]: r for r in bench_records(bench)}
+    assert set(recs) == {"m1", "m2", "m3"}
+    assert recs["m1"]["vs_anchor"] == 0.9
+    # malformed artifact: no records, never a crash
+    bad = tmp_path / "BENCH_bad.json"
+    bad.write_text("not json")
+    assert bench_records(bad) == []
+
+
+def test_real_repo_state_is_gateable():
+    """The actual repo artifacts must pass the gate as-is (a regression
+    here means either a real perf drop or a broken anchor file)."""
+    ok, rows = check()
+    assert ok, rows

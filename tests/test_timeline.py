@@ -111,7 +111,7 @@ def test_summarize_synthetic_trace(tmp_path):
     trace = {
         "traceEvents": [
             {"ph": "X", "name": "matmul", "dur": 10.0},
-            {"ph": "X", "name": "matmul", "dur": 30.0},
+            {"ph": "X", "name": "matmul", "dur": 30.0, "pid": 7, "tid": 2},
             {"ph": "X", "name": "relu", "dur": 5.0},
             {"ph": "M", "name": "meta-only"},
         ]
@@ -119,9 +119,10 @@ def test_summarize_synthetic_trace(tmp_path):
     p = tmp_path / "t.trace.json.gz"
     p.write_bytes(gzip.compress(json.dumps(trace).encode()))
     rows = summarize_trace(p)
+    # events missing pid/tid aggregate under the (0, 0) default track
     assert rows[0] == {"name": "matmul", "total_us": 40.0, "count": 2,
-                       "avg_us": 20.0}
-    assert rows[1]["name"] == "relu"
+                       "avg_us": 20.0, "tracks": 2}
+    assert rows[1]["name"] == "relu" and rows[1]["tracks"] == 1
 
 
 def test_summarize_trace_without_trace_events(tmp_path):
@@ -140,6 +141,33 @@ def test_summarize_trace_without_trace_events(tmp_path):
         {"ph": "X", "name": "no-dur"},
     ]}))
     assert summarize_trace(p3) == []
+    # sparse producers: non-dict events and non-numeric durs are skipped,
+    # not a TypeError mid-triage (fleet_trace merges hit both)
+    p4 = tmp_path / "sparse.json"
+    p4.write_text(json.dumps({"traceEvents": [
+        "not-a-dict",
+        {"ph": "X", "name": "bad", "dur": "fast"},
+        {"ph": "X", "dur": 3.0},  # nameless -> aggregates under "?"
+    ]}))
+    rows = summarize_trace(p4)
+    assert [r["name"] for r in rows] == ["?"]
+
+
+def test_export_chrome_trace_is_host_stamped(profile_dir, tmp_path,
+                                             monkeypatch):
+    """On a shared logdir each host's export carries its host id in the
+    filename, so concurrent exports never shadow each other and
+    scripts/fleet_trace.py can map files back to hosts."""
+    monkeypatch.setenv("DIST_MNIST_TPU_HOST_ID", "3")
+    out = export_chrome_trace(profile_dir)
+    assert out is not None and out.name.startswith("timeline-h3-")
+    monkeypatch.delenv("DIST_MNIST_TPU_HOST_ID")
+    # explicit host id beats the (absent) environment
+    out = export_chrome_trace(profile_dir, host_id=5)
+    assert out.name.startswith("timeline-h5-")
+    # no identity at all: the legacy single-process name
+    out = export_chrome_trace(profile_dir)
+    assert out.name.startswith("timeline-") and "-h" not in out.name
 
 
 def test_profiler_hook_survives_export_failure(tmp_path, monkeypatch, caplog):
