@@ -1,6 +1,16 @@
 """Checkpoint/resume — Saver + CheckpointSaverHook + SessionManager restore,
-rebuilt on Orbax/tensorstore (SURVEY.md §2.4 row 19, §3.5, §5.4)."""
+rebuilt on Orbax/tensorstore (SURVEY.md §2.4 row 19, §3.5, §5.4), plus the
+async write-behind layer (snapshot.py) and peer-ring redundancy (peer.py)
+added by PR 11 (docs/RESILIENCE.md)."""
 
 from dist_mnist_tpu.checkpoint.manager import CheckpointManager
+from dist_mnist_tpu.checkpoint.peer import PeerReplicator, restore_from_peers
+from dist_mnist_tpu.checkpoint.snapshot import AsyncSnapshotter, fork_state
 
-__all__ = ["CheckpointManager"]
+__all__ = [
+    "AsyncSnapshotter",
+    "CheckpointManager",
+    "PeerReplicator",
+    "fork_state",
+    "restore_from_peers",
+]

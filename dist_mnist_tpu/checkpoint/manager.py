@@ -8,6 +8,18 @@ restored (:186-257). Here: Orbax writes each process's shards in parallel
 (tensorstore), keeps a step index, GCs to `max_to_keep`, saves async so the
 TPU never waits on disk, and `restore_or_init` is the prepare_session
 analogue.
+
+Crash consistency (PR 11): a step directory is only RESTORE-ELIGIBLE once
+its commit marker lands at ``<dir>/commits/<step>.committed`` (written
+atomically via rename, only after the write is known durable — immediately
+on the sync path, deferred to the next save()/wait() on the async path,
+which is sound because orbax blocks a new save until the previous async
+write finished). A kill mid-write leaves a step directory with no marker;
+`restore()` quarantines it through the existing ladder without consuming a
+fallback, and `latest_step()` never reports it. A checkpoint directory
+that predates the protocol (steps present, no ``commits/``) is adopted on
+open: its steps get markers, since they were written by a manager that
+waited for durability before exiting.
 """
 
 from __future__ import annotations
@@ -208,9 +220,26 @@ class CheckpointManager:
         self.max_restore_fallbacks = max_restore_fallbacks
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
+        # Multiprocess: orbax's default barrier is
+        # multihost_utils.sync_global_devices — a jitted device all-reduce.
+        # AsyncSnapshotter calls save() from a background writer thread,
+        # and a device collective there deadlocks against the main
+        # thread's training collectives (the two processes enqueue them in
+        # different orders). Naming active_processes explicitly switches
+        # every orbax barrier to the distributed-client KV barrier, which
+        # orbax documents as safe from independent background threads.
+        mp_kwargs = {}
+        if jax.process_count() > 1:
+            mp_kwargs["multiprocessing_options"] = ocp.options.MultiprocessingOptions(
+                active_processes=set(range(jax.process_count())),
+            )
+            # orbax refuses create=True together with active_processes;
+            # the root was mkdir'd above, on every process
+            mp_kwargs["create"] = False
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
+            **mp_kwargs,
         )
         try:
             # declare the item handler up front: without it, a manager that
@@ -224,30 +253,136 @@ class CheckpointManager:
         except TypeError:  # older orbax without item_handlers
             self._mgr = ocp.CheckpointManager(self.directory, options=options)
         self._last_saved: int | None = None
+        self._async = bool(async_save)
+        # step -> dispatch time.monotonic() of async saves whose commit
+        # marker hasn't landed yet (flushed by the next save()/wait())
+        self._pending_commits: dict[int, float] = {}
+        self._commits_dir = self.directory / "commits"
+        self._adopt_legacy_steps()
+
+    # -- commit-marker protocol ---------------------------------------------
+
+    def _adopt_legacy_steps(self) -> None:
+        """First open of a pre-protocol directory (steps, no ``commits/``):
+        mark every existing step committed — its writer waited for
+        durability before exiting. Presence of ``commits/`` afterwards is
+        what distinguishes 'uncommitted step' from 'legacy step'."""
+        if self._commits_dir.exists():
+            return
+        self._commits_dir.mkdir(parents=True, exist_ok=True)
+        for step in self._mgr.all_steps():
+            self._write_marker(int(step))
+
+    def _marker_path(self, step: int) -> Path:
+        return self._commits_dir / f"{step}.committed"
+
+    def _write_marker(self, step: int) -> None:
+        import json
+        import os
+
+        tmp = self._commits_dir / f"{step}.committed.tmp-{os.getpid()}"
+        tmp.write_text(json.dumps({"step": step}), encoding="utf-8")
+        os.replace(tmp, self._marker_path(step))
+
+    def _is_committed(self, step: int) -> bool:
+        return (step in self._pending_commits
+                or self._marker_path(step).exists())
+
+    def _flush_commits(self) -> None:
+        """Write markers for every async save known durable (callers
+        guarantee durability: orbax waited for the previous save, or
+        wait_until_finished just returned), emit the paired
+        ``checkpoint_commit`` events, and prune markers orphaned by
+        retention GC."""
+        if not self._pending_commits:
+            return
+        import time as _time
+
+        live = set(self._mgr.all_steps())
+        for step, dispatch_ts in sorted(self._pending_commits.items()):
+            dur_ms = round((_time.monotonic() - dispatch_ts) * 1e3, 3)
+            if step in live:
+                self._write_marker(step)
+                events.emit("checkpoint_commit", step=step, dur_ms=dur_ms)
+            # a pending step GC'd before its marker landed is simply gone
+        self._pending_commits.clear()
+        for p in self._commits_dir.glob("*.committed"):
+            try:
+                if int(p.stem.split(".")[0]) not in live:
+                    p.unlink(missing_ok=True)
+            except (ValueError, OSError):
+                pass
+
+    def flush_commits(self) -> None:
+        """Opportunistic marker flush for the training loop (called every
+        step by CheckpointHook): an async save's marker must land as soon
+        as the write is durable, not at the NEXT save()/wait() — a kill
+        inside the cadence window would otherwise quarantine a step that
+        WAS durable, rolling the restore back a whole cadence interval.
+
+        Durability authority here is the on-disk FINALIZED step directory
+        (orbax's atomic rename from its ``*.orbax-checkpoint-tmp-*`` name;
+        same plain-``str(step)`` layout `_quarantine` relies on) — NOT
+        `all_steps()`, whose cached view already lists the still-writing
+        step."""
+        if not self._pending_commits:
+            return
+        import time as _time
+
+        for step in sorted(self._pending_commits):
+            if not (self.directory / str(step)).is_dir():
+                continue
+            dispatch_ts = self._pending_commits.pop(step)
+            self._write_marker(step)
+            events.emit(
+                "checkpoint_commit", step=step,
+                dur_ms=round((_time.monotonic() - dispatch_ts) * 1e3, 3),
+            )
 
     def latest_step(self, *, refresh: bool = False) -> int | None:
-        """Newest step on disk. Orbax caches the step list at init;
-        `refresh=True` rescans the directory — required when ANOTHER
-        process/manager is writing (GlobalStepWaiterHook's cross-job
-        observation; ≙ re-reading the `checkpoint` state proto,
-        checkpoint_management.py:251)."""
+        """Newest COMMITTED step on disk (in-process async saves count —
+        their durability is guaranteed before this process exits). Orbax
+        caches the step list at init; `refresh=True` rescans the
+        directory — required when ANOTHER process/manager is writing
+        (GlobalStepWaiterHook's cross-job observation; ≙ re-reading the
+        `checkpoint` state proto, checkpoint_management.py:251)."""
         if refresh:
             self._mgr.reload()
-        return self._mgr.latest_step()
+        committed = [s for s in self._mgr.all_steps() if self._is_committed(s)]
+        return max(committed) if committed else None
 
-    def save(self, state) -> bool:
+    def save(self, state, *, dispatch_ts: float | None = None) -> bool:
         """Save if this step isn't already on disk (re-saving an identical
         step is never useful — e.g. save-on-create right after a restore).
 
         Sharded state (FSDP/TP) is written WITHOUT host-gathering full
         replicas: Orbax serializes each addressable shard straight to
         tensorstore, so an fsdp state's checkpoint I/O per process is
-        1/data-th of the dp case, matching its HBM footprint."""
+        1/data-th of the dp case, matching its HBM footprint.
+
+        `dispatch_ts` (time.monotonic) backdates the dispatch→durable span
+        on the ``checkpoint_commit`` event — the async snapshot layer
+        passes its fork time so the span covers the whole write-behind."""
+        import time as _time
+
         step = state.step_int
         if step == self._last_saved or step == self.latest_step():
             return False
+        t0 = dispatch_ts if dispatch_ts is not None else _time.monotonic()
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
         if saved:
+            # orbax blocked until the PREVIOUS async save landed: those
+            # pending markers are flushable now, this step's is not yet
+            self._pending_commits.pop(step, None)
+            self._flush_commits()
+            if self._async:
+                self._pending_commits[step] = t0
+            else:
+                self._write_marker(step)
+                events.emit(
+                    "checkpoint_commit", step=step,
+                    dur_ms=round((_time.monotonic() - t0) * 1e3, 3),
+                )
             self._last_saved = step
             log.info("checkpoint saved at step %d -> %s", step, self.directory)
             events.emit("checkpoint_save", step=step)
@@ -272,7 +407,22 @@ class CheckpointManager:
         to the next-older step, quarantining the bad directory under
         ``<dir>/quarantine/`` so no later restore trips on it again; at
         most `max_restore_fallbacks` times. Anything else — and corruption
-        with no older step left — re-raises the ORIGINAL error."""
+        with no older step left — re-raises the ORIGINAL error.
+
+        A step directory with NO commit marker (a writer died mid-write —
+        the marker only lands after durability) is quarantined up front
+        WITHOUT consuming a fallback: it never was a restore point, so it
+        must not burn the ladder's budget for genuinely corrupted
+        committed steps."""
+        if self._pending_commits:
+            self.wait()  # our own in-flight writes: make them committed
+        for bad in [s for s in self._mgr.all_steps()
+                    if not self._is_committed(s)]:
+            log.warning(
+                "checkpoint step %d has no commit marker (writer died "
+                "mid-write?); quarantining it", bad,
+            )
+            self._quarantine(bad)
         step = self.latest_step()
         fallbacks = 0
         while step is not None:
@@ -296,6 +446,9 @@ class CheckpointManager:
 
     def _restore_step(self, step: int, target_state):
         """Restore ONE specific step (structure healing included)."""
+        import time as _time
+
+        t0 = _time.monotonic()
         try:
             restored = self._restore_into(step, target_state)
         except Exception as err:
@@ -309,11 +462,13 @@ class CheckpointManager:
                 step, target_state, err
             )
         log.info("restored checkpoint step %d from %s", step, self.directory)
-        events.emit("checkpoint_restore", step=step)
+        events.emit("checkpoint_restore", step=step, source="store",
+                    dur_ms=round((_time.monotonic() - t0) * 1e3, 3))
         return restored
 
     def _step_before(self, step: int) -> int | None:
-        older = [s for s in self._mgr.all_steps() if s < step]
+        older = [s for s in self._mgr.all_steps()
+                 if s < step and self._is_committed(s)]
         return max(older) if older else None
 
     def _quarantine(self, step: int) -> None:
@@ -332,6 +487,8 @@ class CheckpointManager:
             shutil.rmtree(dst)
         if src.exists():
             shutil.move(str(src), str(dst))
+        self._marker_path(step).unlink(missing_ok=True)
+        self._pending_commits.pop(step, None)
         if self._last_saved == step:
             self._last_saved = None  # a re-save of this step must not dedupe
         self._mgr.reload()
@@ -551,7 +708,13 @@ class CheckpointManager:
         return (restored, True) if restored is not None else (init_state, False)
 
     def wait(self) -> None:
+        """Block until every dispatched save is durable AND committed —
+        the durability point `TrainLoop._honor_preemption` and
+        `CheckpointHook.end` rely on before the process may exit."""
         self._mgr.wait_until_finished()
+        self._flush_commits()
 
     def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._flush_commits()
         self._mgr.close()

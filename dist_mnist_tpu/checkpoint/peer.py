@@ -1,0 +1,291 @@
+"""Peer-replicated shards: elastic recovery at local-disk speed.
+
+PR 8's shrink path restores a dead host's shards from the checkpoint
+STORE — the disk round-trip dominates its 2.39 s recovery. The reference
+had nothing faster to offer (one chief owned all V2 files, SURVEY.md
+§3.5); an SPMD fleet does: every host already holds 1/N of the state in
+memory, so each host additionally keeps a REPLICA of its ring neighbor's
+shards (`ring_peer` over cluster/membership.py host ids), and a shrink
+restores the dead host's shards from the surviving peer instead of the
+store — falling back to the store when the peer died with it.
+
+Layout (this repo models "host h's local disk" as ``<root>/h<h>/``; in a
+real fleet the replica write is a neighbor-to-neighbor send):
+
+    <root>/h<holder>/s<src>/step_<N>.npz
+
+``holder`` is whose disk it is, ``src`` is whose shards the file holds —
+each host pushes its own shards to its own dir AND its ring peer's.
+The atomic rename into place IS the commit marker: readers only ever see
+complete files, a kill mid-write leaves a ``.tmp-<pid>`` that no restore
+considers. A restore assembles every source host's pieces from dirs whose
+holder is ALIVE (`DIST_MNIST_TPU_ALIVE_HOSTS`, stamped per generation by
+the elastic supervisor) and verifies full element coverage per leaf; any
+gap — peer and owner both gone, partial write set, src that never wrote —
+returns None and the caller falls back to the store.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from dist_mnist_tpu.cluster.membership import ENV_ALIVE_HOSTS, ring_peer
+
+log = logging.getLogger(__name__)
+
+#: in-flight atomic-write temp files (conftest leak check: a pending entry
+#: after a test means a write path skipped its finally)
+_PENDING_TMP: set = set()
+
+
+def _default_host_of(device) -> int:
+    return int(getattr(device, "process_index", 0))
+
+
+def alive_hosts_from_env(default=None) -> list[int] | None:
+    """Parse the supervisor-stamped alive-host list; `default` when the
+    env is absent (single-generation runs outside the supervisor)."""
+    raw = os.environ.get(ENV_ALIVE_HOSTS)
+    if not raw:
+        return default
+    try:
+        return sorted({int(tok) for tok in raw.split(",") if tok.strip()})
+    except ValueError:
+        log.warning("unparseable %s=%r; ignoring", ENV_ALIVE_HOSTS, raw)
+        return default
+
+
+def _leaf_path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", None) or getattr(k, "name", None) or k)
+        for k in path
+    )
+
+
+def _normalize_index(index, shape):
+    """A shard's index as concrete (start, stop) per dim (Nones resolved)."""
+    spans = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        spans.append((start, stop))
+    return tuple(spans)
+
+
+class PeerReplicator:
+    """Serialize THIS host's addressable shards to its own dir and its
+    ring peer's; assemble any host set's shards back on restore.
+
+    `host_of` maps a jax device to a stable host id — defaults to
+    `device.process_index`; injectable so single-process tests can fake a
+    multi-host fleet over the 8-device CPU mesh."""
+
+    def __init__(self, root: str | Path, host_id: int, hosts, *,
+                 host_of=None, max_to_keep: int = 5):
+        self.root = Path(root).absolute()
+        self.host_id = int(host_id)
+        self.hosts = sorted({int(h) for h in hosts})
+        self.peer = ring_peer(self.host_id, self.hosts)
+        self._host_of = host_of or _default_host_of
+        self.max_to_keep = max(1, int(max_to_keep))
+
+    # -- write side ---------------------------------------------------------
+
+    def write(self, step: int, state) -> None:
+        """Serialize this host's shards of `state` at `step` to local disk
+        and the ring peer's. Runs on the snapshot writer thread — the only
+        host sync in the save path happens here, off the loop."""
+        payload, meta = self._serialize(state)
+        holders = [self.host_id] if self.peer is None else [
+            self.host_id, self.peer,
+        ]
+        for holder in holders:
+            d = self.root / f"h{holder}" / f"s{self.host_id}"
+            d.mkdir(parents=True, exist_ok=True)
+            tmp = d / f"step_{int(step)}.npz.tmp-{os.getpid()}"
+            _PENDING_TMP.add(tmp)
+            try:
+                buf = io.BytesIO()
+                np.savez(buf, __meta__=np.frombuffer(
+                    json.dumps(meta).encode("utf-8"), dtype=np.uint8),
+                    **payload)
+                tmp.write_bytes(buf.getvalue())
+                os.replace(tmp, d / f"step_{int(step)}.npz")
+            finally:
+                _PENDING_TMP.discard(tmp)
+                tmp.unlink(missing_ok=True)
+            self._prune(d)
+
+    def _serialize(self, state):
+        """(npz payload dict, meta list) for every shard this host owns.
+
+        Replicated leaves dedupe to one piece per distinct index span, so
+        a pure-DP state costs each host one full copy (same as orbax's
+        per-process write), an FSDP state 1/data-th."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        payload: dict = {}
+        meta: list = []
+        n = 0
+        for path, leaf in flat:
+            if not isinstance(leaf, jax.Array):
+                continue
+            pieces = []
+            seen = set()
+            for shard in leaf.addressable_shards:
+                if self._host_of(shard.device) != self.host_id:
+                    continue
+                spans = _normalize_index(shard.index, leaf.shape)
+                if spans in seen:
+                    continue  # replicated across this host's devices
+                seen.add(spans)
+                key = f"a{n}"
+                n += 1
+                payload[key] = np.asarray(shard.data)
+                pieces.append({"key": key,
+                               "start": [s for s, _ in spans],
+                               "stop": [e for _, e in spans]})
+            meta.append({
+                "path": _leaf_path_str(path),
+                "shape": list(leaf.shape),
+                "dtype": str(leaf.dtype),
+                "pieces": pieces,
+            })
+        return payload, meta
+
+    def _prune(self, d: Path) -> None:
+        files = sorted(d.glob("step_*.npz"),
+                       key=lambda p: int(p.stem.split("_")[1]))
+        for p in files[:-self.max_to_keep]:
+            p.unlink(missing_ok=True)
+
+    # -- read side ----------------------------------------------------------
+
+    def restore(self, target_state, *, alive=None, min_step=None):
+        return restore_from_peers(
+            self.root, target_state, alive=alive, min_step=min_step,
+        )
+
+
+def _scan(root: Path) -> dict:
+    """{step: {src: [readable file, ...]}} over the whole peer root."""
+    out: dict = {}
+    for holder_dir in root.glob("h*"):
+        for src_dir in holder_dir.glob("s*"):
+            try:
+                src = int(src_dir.name[1:])
+            except ValueError:
+                continue
+            for f in src_dir.glob("step_*.npz"):
+                try:
+                    step = int(f.stem.split("_")[1])
+                except (ValueError, IndexError):
+                    continue
+                out.setdefault(step, {}).setdefault(src, []).append(f)
+    return out
+
+
+def restore_from_peers(root: str | Path, target_state, *, alive=None,
+                       min_step: int | None = None):
+    """Assemble the freshest fully-covered step from alive holders' dirs
+    into `target_state`'s structure and shardings.
+
+    Returns ``(state, step, sources)`` — sources maps src host -> the
+    holder dir its pieces were read from — or None when no step at or
+    above `min_step` has full element coverage from alive holders (the
+    caller then falls back to the checkpoint store). `alive` is a host-id
+    collection; default comes from DIST_MNIST_TPU_ALIVE_HOSTS, else every
+    holder dir present is considered reachable."""
+    root = Path(root).absolute()
+    if not root.exists():
+        return None
+    if alive is None:
+        alive = alive_hosts_from_env()
+    catalog = _scan(root)
+    if alive is not None:
+        alive = {int(h) for h in alive}
+        for step, by_src in catalog.items():
+            for src in list(by_src):
+                by_src[src] = [
+                    f for f in by_src[src]
+                    if int(f.parent.parent.name[1:]) in alive
+                ]
+    for step in sorted(catalog, reverse=True):
+        if min_step is not None and step < min_step:
+            break  # staler than the store's frontier: not worth assembling
+        by_src = {s: fs for s, fs in catalog[step].items() if fs}
+        if not by_src:
+            continue
+        got = _assemble(by_src, target_state)
+        if got is not None:
+            state, sources = got
+            return state, step, sources
+    return None
+
+
+def _assemble(by_src: dict, target_state):
+    """Fill `target_state`-shaped buffers from per-source npz files; None
+    unless every element of every leaf is covered."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target_state)
+    targets = {}
+    for path, leaf in flat:
+        if isinstance(leaf, jax.Array):
+            targets[_leaf_path_str(path)] = leaf
+    # target leaf dtypes are already numpy-compatible dtype objects (jax
+    # arrays carry np.dtype, extended dtypes via ml_dtypes)
+    bufs = {p: np.empty(l.shape, dtype=l.dtype) for p, l in targets.items()}
+    masks = {p: np.zeros(l.shape, dtype=bool) for p, l in targets.items()}
+    sources = {}
+    for src, files in sorted(by_src.items()):
+        f = files[0]
+        sources[src] = str(f.parent.parent.name)
+        try:
+            with np.load(f) as z:
+                meta = json.loads(z["__meta__"].tobytes().decode("utf-8"))
+                for leaf_meta in meta:
+                    p = leaf_meta["path"]
+                    if p not in bufs:
+                        continue  # structure drift: extra leaf, ignore
+                    buf, mask = bufs[p], masks[p]
+                    if list(buf.shape) != list(leaf_meta["shape"]):
+                        log.warning(
+                            "peer shard %s has shape %s, target %s; "
+                            "falling back to the store",
+                            p, leaf_meta["shape"], list(buf.shape),
+                        )
+                        return None
+                    for piece in leaf_meta["pieces"]:
+                        idx = tuple(
+                            slice(a, b) for a, b in
+                            zip(piece["start"], piece["stop"])
+                        )
+                        data = z[piece["key"]]
+                        buf[idx] = data.astype(buf.dtype, copy=False)
+                        mask[idx] = True
+        except (OSError, ValueError, KeyError) as err:
+            log.warning("unreadable peer file %s (%s: %s)",
+                        f, type(err).__name__, str(err)[:200])
+            return None
+    for p, mask in masks.items():
+        if not mask.all():
+            log.info("peer restore incomplete: leaf %s covered %.1f%%",
+                     p, 100.0 * mask.mean())
+            return None
+
+    def place(path, leaf):
+        if not isinstance(leaf, jax.Array):
+            return leaf
+        buf = bufs[_leaf_path_str(path)]
+        return jax.make_array_from_callback(
+            buf.shape, leaf.sharding,
+            lambda idx, b=buf: np.asarray(b[idx]),
+        )
+
+    leaves = [place(path, leaf) for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves), sources

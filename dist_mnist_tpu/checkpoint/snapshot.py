@@ -1,0 +1,280 @@
+"""Async snapshotting: take the checkpoint write off the step critical path.
+
+The reference's chief saved synchronously inside the monitored-session
+loop (SURVEY.md §3.5) — every cadence save stalled training for the full
+host-gather + file write. Here the loop thread pays only for a
+DONATION-SAFE ON-DEVICE FORK of the TrainState (`fork_state`: one
+`jnp.copy` per leaf, dispatched asynchronously, no host gather — the next
+step is free to donate the original buffers) plus a queue handoff; a
+background writer owns the slow part (orbax serialization, commit marker,
+peer replication).
+
+Write-behind is BOUNDED: at most `window` snapshots may be
+forked-but-not-durable at once (queued + in flight). A save that would
+exceed the bound either blocks — the stall is attributed (``save_stall``
+journal event, `save_stall_s` counter, and it lands in the caller's
+`consume_save_s` goodput bucket since the block happens inside `save`) —
+or drops the oldest QUEUED snapshot (``drop_oldest`` policy; the in-flight
+write is never abandoned, so with an empty queue the new fork is admitted
+with a transient one-snapshot overshoot rather than silently discarded).
+
+Durability contract: `wait()` returns only after every accepted snapshot
+is written AND committed (markers flushed — checkpoint/manager.py), and
+re-raises the first writer error. `TrainLoop._honor_preemption` and
+`CheckpointHook.end` already call save+wait, so preemption drain works
+unchanged through this wrapper.
+
+`AsyncSnapshotter` implements the CheckpointManager protocol (save /
+restore / restore_or_init / wait / close / latest_step) and forwards
+everything else to the wrapped manager, so it slots in as both
+`TrainLoop.checkpoint_manager` and `CheckpointHook`'s manager. With a
+`PeerReplicator` attached, the writer additionally serializes the local
+shards to the peer ring after each durable write, and `restore()` tries
+peer assembly (memory/local-disk speed) before the store — see
+checkpoint/peer.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.obs import events
+
+log = logging.getLogger(__name__)
+
+#: writer threads are named <prefix>-<n> so tests can assert none leak
+THREAD_NAME_PREFIX = "SnapshotWriter"
+
+_POLICIES = ("block", "drop_oldest")
+
+
+def fork_state(state):
+    """Device-side copy of every jax.Array leaf of `state`.
+
+    `jnp.copy` dispatches asynchronously and allocates fresh buffers, so
+    the fork is safe against the train step's buffer donation: the loop
+    may donate/overwrite the ORIGINAL state the moment this returns,
+    while the background writer reads the fork at its leisure. Shardings
+    are preserved leaf-by-leaf. No host transfer happens here — that cost
+    stays on the writer thread (orbax reads addressable shards there)."""
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state
+    )
+
+
+class AsyncSnapshotter:
+    """Bounded write-behind checkpointing over a `CheckpointManager`.
+
+    Parameters
+    ----------
+    manager:
+        The durable store (CheckpointManager, possibly fault-wrapped).
+        Constructed with ``async_save=False`` is fine — asyncness is owned
+        by this layer's writer thread, and a synchronous inner write makes
+        the commit marker land in the same writer pass.
+    window:
+        Max snapshots forked-but-not-durable at once (>= 1).
+    policy:
+        ``"block"`` (default) or ``"drop_oldest"`` — what `save` does when
+        the window is full.
+    peer:
+        Optional `PeerReplicator` for ring redundancy + peer-first restore.
+    """
+
+    def __init__(self, manager, *, window: int = 1, policy: str = "block",
+                 peer=None):
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}: {policy!r}")
+        if policy == "drop_oldest" and jax.process_count() > 1:
+            # Drops are decided by LOCAL queue occupancy; two processes can
+            # drop different steps, and the store's per-step cross-process
+            # barriers then wait on a save that one side will never issue.
+            log.warning("drop_oldest is unsafe with %d processes "
+                        "(divergent drops desync the store's per-step "
+                        "barriers); using policy=block",
+                        jax.process_count())
+            policy = "block"
+        self._inner = manager
+        self._peer = peer
+        self._window = max(1, int(window))
+        self._policy = policy
+        self._cond = threading.Condition()
+        self._q: deque = deque()  # (step, forked_state, dispatch_ts)
+        self._busy = False        # writer holds an item (popped, not durable)
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._last_step: int | None = None
+        #: attributed write-behind stalls (block policy) / drops
+        self.save_stall_s = 0.0
+        self.dropped = 0
+
+    # -- manager protocol ---------------------------------------------------
+
+    def save(self, state) -> bool:
+        """Fork + enqueue; never writes on the caller's thread.
+
+        Returns True when a snapshot was accepted (the usual case — the
+        fork itself cannot be deduped against a write that hasn't happened
+        yet, so dedupe is by step against this layer's own history)."""
+        if self._error is not None:
+            raise RuntimeError("snapshot writer failed") from self._error
+        step = state.step_int
+        if step == self._last_step:
+            return False
+        t0 = time.monotonic()
+        fork = fork_state(state)
+        events.emit("snapshot_fork", step=int(step),
+                    fork_ms=round((time.monotonic() - t0) * 1e3, 3))
+        stall = 0.0
+        with self._cond:
+            while len(self._q) + (1 if self._busy else 0) >= self._window:
+                if self._policy == "drop_oldest":
+                    if not self._q:
+                        break  # only the in-flight write remains: overshoot
+                    dropped_step, _, _ = self._q.popleft()
+                    self.dropped += 1
+                    events.emit("snapshot_drop", step=int(dropped_step))
+                    continue
+                t_stall = time.monotonic()
+                self._cond.wait(timeout=0.05)
+                stall += time.monotonic() - t_stall
+                if self._error is not None:
+                    self.save_stall_s += stall
+                    raise RuntimeError(
+                        "snapshot writer failed") from self._error
+            self._q.append((int(step), fork, t0))
+            self._last_step = step
+            self._cond.notify_all()
+        if stall > 0.0:
+            self.save_stall_s += stall
+            events.emit("save_stall", step=int(step),
+                        stall_ms=round(stall * 1e3, 3))
+        self._ensure_thread()
+        return True
+
+    def restore(self, target_state):
+        """Peer-first restore: assemble from the ring when it has a step at
+        least as fresh as the store's committed frontier, else (peer gone,
+        stale, or incomplete) fall through to the store ladder.
+
+        Drains the write-behind queue first: the freshest pre-failure
+        snapshot must be durable before deciding where to restore from
+        (this also keeps fault-injected corrupt-at-restore deterministic —
+        the corruptor targets a settled latest step, not a racing write)."""
+        self.wait()
+        if self._peer is not None:
+            try:
+                store_step = self._inner.latest_step()
+            except Exception:
+                store_step = None
+            t0 = time.monotonic()
+            try:
+                got = self._peer.restore(target_state, min_step=store_step)
+            except Exception as err:  # peer is redundancy, never fatal
+                log.warning("peer restore failed (%s: %s); using the store",
+                            type(err).__name__, str(err)[:200])
+                got = None
+            if got is not None:
+                restored, step, sources = got
+                events.emit(
+                    "peer_restore", step=int(step),
+                    dur_ms=round((time.monotonic() - t0) * 1e3, 3),
+                    sources=sources,
+                )
+                log.info("restored step %d from peer ring (sources=%s)",
+                         step, sources)
+                return restored
+        return self._inner.restore(target_state)
+
+    def restore_or_init(self, init_state):
+        restored = self.restore(init_state)
+        return (restored, True) if restored is not None else (init_state, False)
+
+    def latest_step(self, *, refresh: bool = False):
+        return self._inner.latest_step(refresh=refresh)
+
+    def wait(self) -> None:
+        """Drain: every accepted snapshot durable + committed (peer writes
+        included) before return. Re-raises the first writer error."""
+        with self._cond:
+            while self._q or self._busy:
+                self._cond.wait(timeout=0.05)
+        self._inner.wait()
+        if self._error is not None:
+            raise RuntimeError("snapshot writer failed") from self._error
+
+    def consume_save_stall_s(self) -> float:
+        """Drain the attributed stall counter (bench reporting)."""
+        s, self.save_stall_s = self.save_stall_s, 0.0
+        return s
+
+    def close(self) -> None:
+        try:
+            with self._cond:
+                while (self._q or self._busy) and self._error is None:
+                    self._cond.wait(timeout=0.05)
+        finally:
+            with self._cond:
+                self._stop = True
+                self._cond.notify_all()
+            if self._thread is not None:
+                self._thread.join(timeout=10.0)
+                self._thread = None
+            if self._error is not None:
+                log.error("snapshot writer error at close: %r", self._error)
+            self._inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    # -- writer thread ------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer_loop,
+                name=f"{THREAD_NAME_PREFIX}-{id(self) & 0xFFFF}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._stop:
+                    self._cond.wait(timeout=0.1)
+                if self._stop and not self._q:
+                    return
+                step, fork, dispatch_ts = self._q.popleft()
+                self._busy = True
+                self._cond.notify_all()
+            try:
+                # sync inner write + wait: when this returns, the step is
+                # durable and its commit marker has landed (manager.wait
+                # flushes markers), so `checkpoint_commit`'s dur_ms — back-
+                # dated to the fork via dispatch_ts — spans dispatch→durable
+                self._inner.save(fork, dispatch_ts=dispatch_ts)
+                self._inner.wait()
+                if self._peer is not None:
+                    try:
+                        self._peer.write(step, fork)
+                    except Exception as err:  # redundancy only, never fatal
+                        log.warning(
+                            "peer replication of step %d failed (%s: %s)",
+                            step, type(err).__name__, str(err)[:200],
+                        )
+            except BaseException as err:  # noqa: BLE001 — surfaced in wait()
+                if self._error is None:
+                    self._error = err
+                log.error("snapshot write of step %d failed: %r", step, err)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()

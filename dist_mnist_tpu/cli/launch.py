@@ -45,7 +45,11 @@ from absl import app, flags
 # import order the package sees (its module top is cheap — stdlib + absl)
 import dist_mnist_tpu.cli.train  # noqa: F401
 # stdlib-only (cluster/__init__ resolves lazily, so no jax import here)
-from dist_mnist_tpu.cluster.membership import ENV_HOST_ID, Membership
+from dist_mnist_tpu.cluster.membership import (
+    ENV_ALIVE_HOSTS,
+    ENV_HOST_ID,
+    Membership,
+)
 
 FLAGS = flags.FLAGS
 
@@ -586,6 +590,12 @@ def launch(
             if journal:
                 env_gen[events_mod.ENV_JOURNAL] = journal
                 env_gen[events_mod.ENV_GENERATION] = str(gen)
+            # membership snapshot for this generation: children use it to
+            # decide which peer-ring replica dirs are still reachable after
+            # a shrink (checkpoint/peer.py — a dead host's disk died with it)
+            env_gen[ENV_ALIVE_HOSTS] = ",".join(
+                str(h) for h in (hosts if hosts is not None
+                                 else range(world)))
             if jrnl is not None:
                 jrnl.emit("generation_start", gen=gen, world=world,
                           hosts=hosts)

@@ -183,6 +183,25 @@ flags.DEFINE_boolean("anomaly", False,
 flags.DEFINE_integer("anomaly_every", 25,
                      "anomaly-check cadence in steps (one loss fetch per "
                      "check — the NaNGuard sync budget)")
+flags.DEFINE_boolean("async_snapshot", False,
+                     "take checkpoints off the step critical path "
+                     "(checkpoint/snapshot.py): the loop thread pays only a "
+                     "device-side fork + queue handoff, a background writer "
+                     "owns the orbax write, commit marker and peer "
+                     "replication; drained durably at end/preemption")
+flags.DEFINE_integer("snapshot_window", 1,
+                     "bounded write-behind window for --async_snapshot: max "
+                     "snapshots forked-but-not-durable at once")
+flags.DEFINE_enum("snapshot_policy", "block", ["block", "drop_oldest"],
+                  "what a save does when the snapshot window is full: block "
+                  "(attributed save_stall) or drop the oldest queued "
+                  "snapshot")
+flags.DEFINE_string("peer_dir", None,
+                    "peer-ring shard redundancy root (checkpoint/peer.py): "
+                    "each host serializes its shards to its own dir AND its "
+                    "ring neighbor's, and restore assembles from surviving "
+                    "peers before falling back to the checkpoint store. "
+                    "Implies the async snapshot path. None = off")
 
 
 def build_optimizer(cfg):
@@ -290,6 +309,10 @@ def _run_config(
     span_steps: int = 0,
     anomaly: bool = False,
     anomaly_every: int = 25,
+    async_snapshot: bool = False,
+    snapshot_window: int = 1,
+    snapshot_policy: str = "block",
+    peer_dir: str | None = None,
 ):
     """Implementation behind `run_config` (the public wrapper adds the
     PRNG-impl scope — call THAT, not this).
@@ -366,6 +389,10 @@ def _run_config(
             elastic_baseline_devices=elastic_baseline_devices,
             span_steps=span_steps, anomaly=anomaly,
             anomaly_every=anomaly_every,
+            async_snapshot=async_snapshot,
+            snapshot_window=snapshot_window,
+            snapshot_policy=snapshot_policy,
+            peer_dir=peer_dir,
         )
         import jax as _jax
 
@@ -428,6 +455,10 @@ def _run_train(
     span_steps: int = 0,
     anomaly: bool = False,
     anomaly_every: int = 25,
+    async_snapshot: bool = False,
+    snapshot_window: int = 1,
+    snapshot_policy: str = "block",
+    peer_dir: str | None = None,
 ):
     """The training run itself (see `_run_config`, which wraps it in the
     observability scope and owns the exporter/journal lifecycles)."""
@@ -566,13 +597,44 @@ def _run_train(
         manager = None
         restored = False
         if checkpoint_dir:
+            # --peer_dir implies the async snapshot path: peer replication
+            # runs on the snapshot writer thread. The inner orbax manager
+            # goes SYNC under the snapshotter — asyncness is owned by the
+            # write-behind layer, and a sync inner write lets the commit
+            # marker land in the same writer pass.
+            wrap_async = bool(async_snapshot or peer_dir)
             manager = CheckpointManager(
-                checkpoint_dir, max_restore_fallbacks=max_restore_fallbacks
+                checkpoint_dir, async_save=not wrap_async,
+                max_restore_fallbacks=max_restore_fallbacks,
             )
             if fault_plan is not None:
                 # wrap BEFORE the startup restore so a corrupt fault
                 # targeting a pre-existing step fires on restore_or_init too
                 manager = fault_plan.wrap_checkpoint_manager(manager)
+            if wrap_async:
+                import os as _os
+
+                from dist_mnist_tpu.checkpoint import (
+                    AsyncSnapshotter,
+                    PeerReplicator,
+                )
+
+                peer = None
+                if peer_dir:
+                    from dist_mnist_tpu.checkpoint.peer import (
+                        alive_hosts_from_env,
+                    )
+                    from dist_mnist_tpu.cluster.membership import ENV_HOST_ID
+
+                    host_id = int(_os.environ.get(
+                        ENV_HOST_ID, jax.process_index()))
+                    hosts = alive_hosts_from_env(
+                        default=list(range(jax.process_count())))
+                    peer = PeerReplicator(peer_dir, host_id, hosts)
+                manager = AsyncSnapshotter(
+                    manager, window=snapshot_window,
+                    policy=snapshot_policy, peer=peer,
+                )
             with startup.phase("restore"):
                 state, restored = manager.restore_or_init(state)
         log.info(
@@ -902,6 +964,10 @@ def main(argv):
             span_steps=FLAGS.span_steps,
             anomaly=FLAGS.anomaly,
             anomaly_every=FLAGS.anomaly_every,
+            async_snapshot=FLAGS.async_snapshot,
+            snapshot_window=FLAGS.snapshot_window,
+            snapshot_policy=FLAGS.snapshot_policy,
+            peer_dir=FLAGS.peer_dir,
         )
     finally:
         uninstall()

@@ -24,6 +24,22 @@ from __future__ import annotations
 # rank, by contrast, is positional and changes when the mesh resizes).
 ENV_HOST_ID = "DIST_MNIST_TPU_HOST_ID"
 
+# Per-generation env var: comma-separated stable host ids admitted to THIS
+# generation (the supervisor's membership.alive() at launch). Children use
+# it to decide which peer-ring replica dirs are reachable after a shrink —
+# a dead host's local disk is gone with it (checkpoint/peer.py).
+ENV_ALIVE_HOSTS = "DIST_MNIST_TPU_ALIVE_HOSTS"
+
+
+def ring_peer(host: int, hosts) -> int | None:
+    """The ring neighbor that holds `host`'s replica shards: the next id in
+    the sorted host list, wrapping. None when `host` is alone (a 1-host
+    world has no distinct peer) or not a member."""
+    ring = sorted(set(hosts))
+    if host not in ring or len(ring) < 2:
+        return None
+    return ring[(ring.index(host) + 1) % len(ring)]
+
 
 class Membership:
     """Tracks alive/excluded hosts and their recovery deadlines."""

@@ -30,6 +30,14 @@ supervised restarts) has a metric to move:
                      --elastic) is designed to shrink: no backoff, no
                      full-world restart, warm-started executables at the
                      new mesh shape.
+- ``save_s``       — host-side checkpoint save time spent inside the
+                     step window: the blocking orbax write on the sync
+                     path, or only fork+dispatch (plus any attributed
+                     write-behind ``save_stall``) on the async snapshot
+                     path (checkpoint/snapshot.py). Split out of
+                     "productive" so `bench.py --ckpt` can show the
+                     async layer actually moving save cost off the
+                     critical path.
 
 ``goodput_fraction = productive_s / total_wall_s`` — everything not in
 the productive bucket (including untracked overhead: hook bodies, eval,
@@ -61,6 +69,7 @@ class GoodputClock:
         self.stall_s = 0.0
         self.compile_s = 0.0
         self.resize_s = 0.0
+        self.save_s = 0.0
         self.replayed_steps = 0
         #: one dict per recovery: failed_at_step, restored_step, restore_s,
         #: replay_s, replayed_steps, complete, latency_s (once known)
@@ -89,6 +98,13 @@ class GoodputClock:
         that observe the whole supervised run — an individual generation
         cannot see its own bring-up window."""
         self.resize_s += dt
+
+    def add_save(self, dt: float) -> None:
+        """Checkpoint save time spent inside the step window (hook-side
+        dispatch and/or blocking write; reported by CheckpointHook's
+        `consume_save_s`, subtracted from the step's productive time by
+        the loop exactly like compile_s)."""
+        self.save_s += dt
 
     @property
     def in_replay(self) -> bool:
@@ -165,6 +181,7 @@ class GoodputClock:
             "stall_s": self.stall_s,
             "compile_s": self.compile_s,
             "resize_s": self.resize_s,
+            "save_s": self.save_s,
             "total_wall_s": self.total_wall_s(),
             "goodput_fraction": self.goodput_fraction(),
             "recoveries": len(self.events),

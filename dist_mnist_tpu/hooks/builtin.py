@@ -266,26 +266,57 @@ class CheckpointHook(Hook):
                  every_secs: float | None = 600.0):
         self._mgr = manager
         self._timer = EverySteps(every_steps=every_steps, every_secs=every_secs)
+        self._save_s = 0.0
 
     def begin(self, loop):
         self._loop = loop
         # save-on-create (:585-602): guarantees a restore point exists before
-        # the first cadence trigger; a restored state dedupes by step.
+        # the first cadence trigger. Skipped when one ALREADY exists for the
+        # loop's initial step (the restore that produced this state): the
+        # save would dedupe anyway, but probing latest_step here avoids even
+        # forking a snapshot on the async path. Blocks the first step only
+        # as long as the manager's save() does — milliseconds under
+        # AsyncSnapshotter, where the write rides the background path.
         self._timer.prime(loop.initial_step)
-        self._mgr.save(loop.state)
+        latest = None
+        probe = getattr(self._mgr, "latest_step", None)
+        if probe is not None:
+            try:
+                latest = probe()
+            except TypeError:  # duck-typed managers with odd signatures
+                latest = None
+        if latest is None or latest < loop.initial_step:
+            self._mgr.save(loop.state)
 
     def after_step(self, step, state, outputs):
         if self._timer.should_trigger(step):
             self._timer.mark()
-            # journal the save as a `checkpoint` span (host-side dispatch
-            # time; async managers return before the write lands). The
-            # save cadence IS the span's cadence gate, and emit() is a
-            # no-op without a journal, so the clock costs nothing extra.
+            # journal the save as a `checkpoint` span — HOST-SIDE DISPATCH
+            # only (async managers return at the fork/handoff; the paired
+            # `checkpoint_commit` event lands when the background write is
+            # durable, so dispatch→durable shows as a real span in
+            # scripts/fleet_trace.py). The save cadence IS the span's
+            # cadence gate, and emit() is a no-op without a journal, so
+            # the clock costs nothing extra.
             t0 = time.monotonic()
             self._mgr.save(state)
+            dt = time.monotonic() - t0
+            self._save_s += dt  # drained by the loop into goodput save_s
             obs_events.emit(
                 "span", name="checkpoint", step=int(step),
-                dur_ms=round((time.monotonic() - t0) * 1e3, 3))
+                dur_ms=round(dt * 1e3, 3))
+        # commit markers for async saves land the moment the write is
+        # durable, not at the next cadence save — a kill inside the
+        # cadence window must not quarantine a durable step
+        flush = getattr(self._mgr, "flush_commits", None)
+        if flush is not None:
+            flush()
+
+    def consume_save_s(self) -> float:
+        """Hook-side save time since last drain (TrainLoop charges it to
+        the goodput `save_s` bucket and keeps it out of productive)."""
+        s, self._save_s = self._save_s, 0.0
+        return s
 
     def end(self, state):
         self._mgr.save(state)

@@ -258,7 +258,19 @@ class TrainLoop:
                             g.add_compile(compile_s)
                     for h in self.hooks:
                         h.after_step(self._host_step, self.state, outputs)
-                    dt_step = max(0.0, time.monotonic() - t_step - compile_s)
+                    # hook-side checkpoint save time (blocking write on the
+                    # sync path, fork+dispatch + attributed stall on the
+                    # async snapshot path) — split into the save_s bucket
+                    # and OUT of productive, exactly like compile_s
+                    save_s = 0.0
+                    for h in self.hooks:
+                        consume_save = getattr(h, "consume_save_s", None)
+                        if consume_save is not None:
+                            save_s += consume_save()
+                    if save_s:
+                        g.add_save(save_s)
+                    dt_step = max(0.0, time.monotonic() - t_step - compile_s
+                                  - save_s)
                     # per-STEP wall time even when step_fn runs a chunk
                     self.step_time_hist.observe(
                         dt_step * 1e3 / self.steps_per_call)

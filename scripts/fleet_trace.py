@@ -13,10 +13,14 @@ with wall-clock timestamps. Those three coordinates — (host, gen, step)
     ts  = journal wall clock, rebased to the earliest record
 
 `span` records with `dur_ms` become complete events (ph "X") ending at
-their journal timestamp; spans without a duration (h2d carries bytes,
-not time) and every lifecycle record (generation_resize, preemption,
-straggler_detected, anomaly, checkpoint_*) become instants (ph "i"), so
-the resize/fault story lines up against the per-host step work.
+their journal timestamp — and so does `checkpoint_commit`, whose dur_ms
+is back-dated to the save's DISPATCH (checkpoint/manager.py), so the
+async write-behind shows as a real dispatch→durable bar next to the
+skinny host-side `checkpoint` span it detached from. Spans without a
+duration (h2d carries bytes, not time) and every other lifecycle record
+(generation_resize, preemption, straggler_detected, anomaly,
+checkpoint_restore, peer_restore) become instants (ph "i"), so the
+resize/fault story lines up against the per-host step work.
 
 Per-host profiler exports (obs/timeline.py `timeline-h<host>-<run>.json`)
 can be merged in with --timelines: their events keep their internal
@@ -97,6 +101,18 @@ def journal_events(recs: list[dict]) -> list[dict]:
             out.append({
                 "name": rec.get("name", "span"), "ph": "i", "s": "t",
                 "cat": "span", "ts": round(ts_us, 3),
+                "pid": pid, "tid": tid, "args": args,
+            })
+        elif (event == "checkpoint_commit"
+              and isinstance(rec.get("dur_ms"), (int, float))):
+            # dur_ms spans dispatch (snapshot fork / save call) -> durable
+            # (commit marker on disk): render it as a bar so the write-
+            # behind window is visible against the step work above it
+            dur_us = rec["dur_ms"] * 1e3
+            out.append({
+                "name": "checkpoint_commit", "ph": "X", "cat": "checkpoint",
+                "ts": round(max(0.0, ts_us - dur_us), 3),
+                "dur": round(dur_us, 3),
                 "pid": pid, "tid": tid, "args": args,
             })
         else:

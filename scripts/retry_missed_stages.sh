@@ -59,6 +59,7 @@ for i in $(seq 1 "$ROUNDS"); do
     run_stage bench_memory    900 python bench.py --memory --deadline 800
     run_stage bench_faults    900 python bench.py --faults --deadline 800
     run_stage bench_elastic   900 python bench.py --faults --elastic --deadline 800
+    run_stage bench_ckpt      900 python bench.py --ckpt --deadline 800
     run_stage bench_coldstart 900 python bench.py --coldstart --deadline 800
     run_stage bench_overlap   900 python bench.py --overlap --deadline 800
     run_stage step_ablation   1800 python scripts/step_ablation.py

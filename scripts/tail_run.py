@@ -74,6 +74,16 @@ def format_record(rec: dict) -> str:
         else:
             head = f"{rec.get('name', '?')} "
             skip = _FIXED + ("name",)
+    elif event == "checkpoint_commit":
+        # dur_ms spans dispatch->durable (checkpoint/manager.py): lead
+        # with step + span so the write-behind window reads inline.
+        head = (f"step={rec.get('step', '?')} durable after "
+                f"{_fmt_num(rec.get('dur_ms'), 'ms')} ")
+        skip = _FIXED + ("step", "dur_ms")
+    elif event in ("peer_restore", "checkpoint_restore"):
+        head = (f"step={rec.get('step', '?')} "
+                f"{_fmt_num(rec.get('dur_ms'), 'ms')} ")
+        skip = _FIXED + ("step", "dur_ms")
     # journal records are host-stamped when DIST_MNIST_TPU_HOST_ID was set
     # in the emitting process; fold that into the fixed columns so merged
     # fleet journals stay scannable. generation_resize keeps its own

@@ -62,7 +62,11 @@ def _no_leaked_prefetch_workers():
     child after launch() returned would outlive the test and poison the
     next one's port/coordinator), compile-cache atomic-write temp files
     (compilecache/store.py `_PENDING_TMP` — a pending entry means a save
-    path skipped its finally), metrics-exporter HTTP threads/sockets
+    path skipped its finally), async snapshot writer threads
+    (``SnapshotWriter`` — checkpoint/snapshot.py; alive after a test means
+    a manager close/wait path was skipped) and peer-replica atomic-write
+    temp files (checkpoint/peer.py `_PENDING_TMP`), metrics-exporter
+    HTTP threads/sockets
     (``ObsExporter*`` serve threads and obs/exporter.py's
     ``_LIVE_EXPORTERS`` — an unclosed exporter holds a bound port for the
     rest of the session), and warm-start/coldstart/journal temp dirs
@@ -92,6 +96,7 @@ def _no_leaked_prefetch_workers():
                        or t.name.startswith("Fault")
                        or t.name.startswith("Elastic")
                        or t.name.startswith("CompileCache")
+                       or t.name.startswith("SnapshotWriter")
                        or t.name.startswith("ObsExporter"))]
         exporter_mod = sys.modules.get("dist_mnist_tpu.obs.exporter")
         if exporter_mod is not None:
@@ -105,6 +110,10 @@ def _no_leaked_prefetch_workers():
         if store_mod is not None:
             leaked += [f"pending cache tmp {p}"
                        for p in store_mod._PENDING_TMP]
+        peer_mod = sys.modules.get("dist_mnist_tpu.checkpoint.peer")
+        if peer_mod is not None:
+            leaked += [f"pending peer tmp {p}"
+                       for p in peer_mod._PENDING_TMP]
         leaked += [f"stray tmp dir {p}" for g in _stray_globs
                    for p in tmp_root.glob(g) if p not in before]
         if not leaked:
