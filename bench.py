@@ -556,6 +556,218 @@ def bench_serve(n_requests: int, concurrency: int) -> int:
     return 0
 
 
+def bench_serve_fleet(n_requests: int, concurrency: int, *,
+                      replicas: int = 3) -> int:
+    """Fleet-serving robustness: two-class traffic through a 3-replica
+    `serve/router.py` Router while a seeded fault plan kills one replica
+    and stalls another, then a new checkpoint commit triggers a live
+    replica-by-replica weight roll UNDER load. Reports the
+    latency-sensitive p99 across both events (the SLO the tiering, hedging
+    and failover machinery exists to protect), and asserts the router
+    contract outright: zero latency-sensitive requests failed or shed
+    (only best-effort may shed), zero in-flight requests dropped, and the
+    fleet serving the new weights at the end. `replica_down` -> first
+    rerouted response is reported as recovery_ms."""
+    import dataclasses
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from dist_mnist_tpu.checkpoint.manager import CheckpointManager
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.faults import Fault, FaultPlan
+    from dist_mnist_tpu.models.registry import get_model
+    from dist_mnist_tpu.obs import HealthState, RunJournal
+    from dist_mnist_tpu.obs import events as events_mod
+    from dist_mnist_tpu.optim import adam
+    from dist_mnist_tpu.serve import (
+        LATENCY_SENSITIVE,
+        BEST_EFFORT,
+        CheckpointWatcher,
+        CompiledModelCache,
+        InferenceEngine,
+        InferenceServer,
+        InProcessReplica,
+        Router,
+        RouterConfig,
+        ServeConfig,
+        load_for_serving,
+        run_fleet_loadgen,
+    )
+    from dist_mnist_tpu.train.state import create_train_state
+
+    metric = "fleet_p99_latency_sensitive_ms"
+    base_step, new_step = 100, 200
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    journal = RunJournal(f"{tmp}/events.jsonl")
+    prev_journal = events_mod.set_journal(journal)
+    mesh = make_mesh(MeshSpec(data=-1))
+    cfg = get_config("mlp_mnist")
+    ckpt_dir = f"{tmp}/ckpt"
+
+    # a real committed checkpoint as the swap SOURCE: base weights at
+    # base_step now, perturbed weights at new_step mid-run (the commit the
+    # watcher reacts to)
+    model = get_model(cfg.model, **cfg.model_kwargs)
+    sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
+    state = create_train_state(model, adam(1e-3),
+                               jax.random.PRNGKey(cfg.seed), sample)
+    state = dataclasses.replace(state, step=jnp.asarray(base_step, jnp.int32))
+    mgr = CheckpointManager(ckpt_dir, async_save=False)
+    assert mgr.save(state)
+    mgr.wait()
+
+    bundle = load_for_serving(cfg, mesh, checkpoint_dir=ckpt_dir,
+                              step=base_step)
+    assert bundle.restored
+    # seeded incident plan, per-replica predict-call ordinals: replica 0
+    # straggles once early (hedge territory), replica 1 dies permanently
+    # (failover territory); replica 2 is never touched
+    plan = FaultPlan([
+        Fault.serve_replica_stall(replica=0, seconds=0.25, request=2),
+        Fault.serve_replica_kill(replica=1, request=3),
+    ])
+    shared_cache = CompiledModelCache()
+
+    def make_server_factory(rid: int):
+        def make_server():
+            engine = InferenceEngine(
+                bundle.model, bundle.params, bundle.model_state, mesh,
+                model_name="mlp", image_shape=bundle.image_shape,
+                rules=bundle.rules, max_bucket=32, cache=shared_cache)
+            return InferenceServer(
+                plan.wrap_engine(engine, replica_id=rid),
+                ServeConfig(max_batch=32, max_wait_ms=1.0,
+                            queue_depth=4 * concurrency),
+                health=HealthState(),
+            ).start()
+
+        return make_server
+
+    def load_weights(step: int):
+        new = load_for_serving(cfg, mesh, checkpoint_dir=ckpt_dir, step=step)
+        if not new.restored:
+            raise FileNotFoundError(f"no committed checkpoint at {step}")
+        return new.params, new.model_state
+
+    fleet = [InProcessReplica(i, make_server_factory(i),
+                              load_weights=load_weights).start()
+             for i in range(replicas)]
+    router = Router(fleet, RouterConfig(hedge_after_ms=50.0,
+                                        health_interval_s=0.05),
+                    ).start()
+    watcher = CheckpointWatcher(ckpt_dir, router.roll_weights,
+                                poll_interval_s=0.05,
+                                initial_step=base_step)
+
+    def run_phase(n, seed):
+        return run_fleet_loadgen(
+            router, n_requests=n, concurrency=concurrency,
+            image_shape=bundle.image_shape, seed=seed, ls_fraction=0.8,
+            keep_latencies=True)
+
+    try:
+        # -- phase 1: the stall + the kill land under steady load ------------
+        phase1 = run_phase(n_requests, seed=0)
+        extra_rounds = 0
+        while any(not f.fired for f in plan.faults) and extra_rounds < 5:
+            # ordinals are per-replica; tiny fleets can need a little more
+            # traffic before the victim's own call clock reaches them
+            extra_rounds += 1
+            run_phase(max(concurrency * 2, 64), seed=10 + extra_rounds)
+        assert all(f.fired for f in plan.faults), \
+            f"fault plan did not fully fire: {plan.to_json()}"
+
+        # -- phase 2: commit new weights mid-load; the watcher rolls ---------
+        watcher.start()
+        phase2_out: dict = {}
+
+        def phase2_run():
+            phase2_out.update(run_phase(n_requests, seed=1))
+
+        t_load = threading.Thread(target=phase2_run, name="fleet-phase2")
+        t_load.start()
+        time.sleep(0.15)  # the roll must overlap live traffic
+        state2 = dataclasses.replace(
+            state, step=jnp.asarray(new_step, jnp.int32),
+            params=jax.tree.map(lambda p: p + 1.0, state.params))
+        assert mgr.save(state2)
+        mgr.wait()
+        t_load.join(timeout=180)
+        assert not t_load.is_alive(), "phase-2 loadgen hung"
+        deadline = time.monotonic() + 30
+        while router.serving_step != new_step:
+            assert time.monotonic() < deadline, "weight roll never completed"
+            time.sleep(0.05)
+
+        # -- the router contract, asserted ----------------------------------
+        for phase, name in ((phase1, "phase1"), (phase2_out, "phase2")):
+            assert phase["errors"][LATENCY_SENSITIVE] == 0, \
+                f"{name}: LS errors {phase['errors']}"
+            assert phase["shed"][LATENCY_SENSITIVE] == 0, \
+                f"{name}: LS shed {phase['shed']}"
+            assert sum(phase["dropped"].values()) == 0, \
+                f"{name}: dropped in-flight {phase['dropped']}"
+        rsnap = router.metrics.snapshot()
+        assert rsnap["replica_downs"] >= 1, "kill never surfaced"
+        assert rsnap["recovery_ms"], "no failover recovery latency recorded"
+        assert rsnap["swaps"] >= replicas - 1, \
+            f"expected >= {replicas - 1} live-replica swaps, got {rsnap}"
+        for r in fleet:
+            if router.replica_states()[r.id] == "serving":
+                assert r.server.engine.weights_version == new_step
+
+        recs = events_mod.read_journal(f"{tmp}/events.jsonl")
+        kinds = [r.get("event") for r in recs]
+        assert "replica_down" in kinds and "failover_first_response" in kinds
+        n_swap_ok = sum(1 for r in recs
+                        if r.get("event") == "weights_swap" and r.get("ok"))
+        assert n_swap_ok >= replicas - 1
+
+        ls_lat = (phase1["raw_latencies"][LATENCY_SENSITIVE]
+                  + phase2_out["raw_latencies"][LATENCY_SENSITIVE])
+        import numpy as np
+
+        p99 = float(np.percentile(np.asarray(ls_lat), 99))
+        emit({
+            "metric": metric,
+            "value": round(p99, 2),
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "extra": {
+                "chips": jax.device_count(),
+                "replicas": replicas,
+                "recovery_ms": round(rsnap["recovery_ms"][0], 2),
+                "phase1_ls": phase1[f"latency_{LATENCY_SENSITIVE}"],
+                "phase2_ls": phase2_out[f"latency_{LATENCY_SENSITIVE}"],
+                "be_shed": {"phase1": phase1["shed"][BEST_EFFORT],
+                            "phase2": phase2_out["shed"][BEST_EFFORT]},
+                "hedges": rsnap["hedges"],
+                "requeues": rsnap["requeues"],
+                "swaps": rsnap["swaps"],
+                "swap_ok_events": n_swap_ok,
+                "serving_step": router.serving_step,
+                "cache": shared_cache.stats()["hits_memory"],
+                **_anchor_fields(metric, p99),
+            },
+        })
+    finally:
+        watcher.close()
+        router.close()
+        for r in fleet:
+            r.close(timeout=10)
+        mgr.close()
+        events_mod.set_journal(prev_journal)
+        journal.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
 def bench_input(n_timed: int, *, depth: int = 2, batch: int = 1024,
                 warmup: int = 5) -> int:
     """Input-stall attribution: the same model/stream timed twice — once
@@ -1903,6 +2115,15 @@ if __name__ == "__main__":
     ap.add_argument("--serve", action="store_true",
                     help="serving-latency mode: p99 request latency through "
                          "the online inference server (serve_p99_latency_ms)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="with --serve: fleet-robustness mode — two-class "
+                         "traffic through a multi-replica router under a "
+                         "seeded replica-kill + replica-stall plan and a "
+                         "live weight hot-swap; asserts zero "
+                         "latency-sensitive failures and reports their p99 "
+                         "(fleet_p99_latency_sensitive_ms)")
+    ap.add_argument("--fleet-replicas", type=int, default=3,
+                    help="fleet size in --serve --fleet mode")
     ap.add_argument("--input", action="store_true", dest="input_mode",
                     help="input-stall attribution mode: time sync-feed vs "
                          "device-prefetched feed on the same model/stream "
@@ -1967,7 +2188,9 @@ if __name__ == "__main__":
         # deadline (the parent bounds it), raw traceback on failure (the
         # parent wraps it into ITS structured line)
         sys.exit(coldstart_child(args.coldstart_child, args.coldstart_steps))
-    metric = ("serve_p99_latency_ms" if args.serve
+    metric = ("fleet_p99_latency_sensitive_ms"
+              if args.serve and args.fleet
+              else "serve_p99_latency_ms" if args.serve
               else "input_stall_ms_per_step" if args.input_mode
               else "fsdp_per_device_state_bytes" if args.memory_mode
               else "comm_exposed_ms_per_step" if args.overlap_mode
@@ -1993,7 +2216,11 @@ if __name__ == "__main__":
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     try:
-        sys.exit(bench_serve(args.requests, args.concurrency) if args.serve
+        sys.exit(bench_serve_fleet(args.requests, args.concurrency,
+                                   replicas=args.fleet_replicas)
+                 if args.serve and args.fleet
+                 else bench_serve(args.requests, args.concurrency)
+                 if args.serve
                  else bench_input(args.steps, depth=args.prefetch_depth)
                  if args.input_mode
                  else bench_memory(args.config) if args.memory_mode

@@ -1,0 +1,390 @@
+"""Fleet-router driver: N serving replicas behind one `serve/router.py`
+Router, driven with two-class traffic.
+
+`python -m dist_mnist_tpu.cli.router --config=mlp_mnist --replicas=3 \
+    --platform=cpu --host_device_count=8 --checkpoint_dir=/tmp/ckpt`
+
+Two fleet shapes:
+
+- ``--inprocess`` (default): replicas are `InProcessReplica`s in this
+  process, sharing one `CompiledModelCache` (AOT executables take the
+  weights as runtime arguments, so the fleet compiles each bucket once).
+  Fast to stand up; what tests and bench use.
+- ``--noinprocess``: each replica is a spawned
+  `cli/serve.py --serve_forever` subprocess on its own port, reached via
+  `HttpReplica` (POST /predict, /swap; probed over /healthz). A
+  `FleetScraper` (obs/fleet.py, PR 9's cross-host poller) scrapes every
+  replica's /metrics and merges them onto THIS process's exporter — one
+  scrape shows the whole serving fleet.
+
+Either way the router gives SLO-tiered admission, health-probe routing,
+retry/hedge/failover, and — with ``--watch`` — the zero-downtime weight
+roll driven by the training run's commit markers (docs/SERVING.md
+"Fleet router").
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from absl import app, flags
+
+log = logging.getLogger(__name__)
+
+FLAGS = flags.FLAGS
+
+flags.DEFINE_string("config", "mlp_mnist", "config name (see configs.py)")
+flags.DEFINE_string("checkpoint_dir", None,
+                    "checkpoint directory to serve from (and to watch for "
+                    "commit markers with --watch); None = fresh init")
+flags.DEFINE_integer("step", None, "initial checkpoint step (None = latest)")
+flags.DEFINE_integer("replicas", 3, "fleet size")
+flags.DEFINE_boolean("inprocess", True,
+                     "in-process replicas (shared compile cache); "
+                     "--noinprocess spawns cli/serve.py --serve_forever "
+                     "subprocesses reached over HTTP")
+flags.DEFINE_string("mesh", None, 'mesh override, e.g. "data=8"')
+flags.DEFINE_string("platform", None, "pin the jax backend (e.g. cpu)")
+flags.DEFINE_integer("host_device_count", None,
+                     "with --platform=cpu: number of virtual host devices")
+# -- per-replica serving policy ----------------------------------------------
+flags.DEFINE_integer("max_batch", 64, "coalesce ceiling (requests per batch)")
+flags.DEFINE_float("max_wait_ms", 2.0, "coalesce window after first request")
+flags.DEFINE_integer("queue_depth", 256, "per-replica admission bound")
+flags.DEFINE_string("compile_cache_dir", None,
+                    "compilecache/ directory shared by the fleet; restarts "
+                    "and subprocess replicas rewarm from its disk tier")
+flags.DEFINE_string("fault_plan", None,
+                    "faults/plan.py FaultPlan JSON (inline or path); "
+                    "serve_replica_kill / serve_replica_stall target "
+                    "replica ids, exercising failover and hedging")
+# -- router policy ------------------------------------------------------------
+flags.DEFINE_float("hedge_after_ms", 0,
+                   "fixed hedge timeout for latency_sensitive requests; "
+                   "0 = derive from the live p99")
+flags.DEFINE_float("health_interval_s", 0.1, "replica probe cadence")
+flags.DEFINE_boolean("watch", False,
+                     "poll <checkpoint_dir>/commits and hot-swap the fleet "
+                     "to each newly committed step (zero-downtime roll)")
+flags.DEFINE_float("watch_interval_s", 2.0, "commit-marker poll cadence")
+# -- load generation ----------------------------------------------------------
+flags.DEFINE_integer("requests", 512, "loadgen request count")
+flags.DEFINE_integer("concurrency", 64, "loadgen in-flight window")
+flags.DEFINE_integer("seed", 0, "loadgen input/class seed")
+flags.DEFINE_float("ls_fraction", 0.8, "latency_sensitive traffic fraction")
+flags.DEFINE_float("ls_deadline_ms", 0,
+                   "latency_sensitive per-request deadline; 0 = none")
+flags.DEFINE_float("be_deadline_ms", 0,
+                   "best_effort per-request deadline; 0 = none")
+# -- observability ------------------------------------------------------------
+flags.DEFINE_integer("metrics_port", 0,
+                     "router-process /metrics (incl. fleet/ gauges and, in "
+                     "subprocess mode, the FleetScraper's merged replica "
+                     "series), /healthz and /events; 0 = disabled")
+flags.DEFINE_string("journal", None,
+                    "append-only JSONL run-journal path (obs/events.py); "
+                    "replica_up/down, shed, weights_swap etc. land here")
+
+# conftest leak registry: spawned replica subprocesses still alive after a
+# test are leaks (mirrors cli/launch.py's _LIVE_CHILDREN)
+_LIVE_REPLICA_PROCS: list = []
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_replicas(n: int):
+    """Spawn n `cli/serve.py --serve_forever` children and wait until each
+    /healthz reports serving. Returns (procs, HttpReplicas)."""
+    import os
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    from dist_mnist_tpu.obs import events as events_mod
+    from dist_mnist_tpu.serve import HttpReplica
+
+    procs, urls = [], {}
+    for i in range(n):
+        port = _free_port()
+        cmd = [
+            sys.executable, "-m", "dist_mnist_tpu.cli.serve",
+            "--serve_forever", f"--config={FLAGS.config}",
+            f"--metrics_port={port}", f"--replica_id={i}",
+            f"--max_batch={FLAGS.max_batch}",
+            f"--max_wait_ms={FLAGS.max_wait_ms}",
+            f"--queue_depth={FLAGS.queue_depth}",
+        ]
+        if FLAGS.checkpoint_dir:
+            cmd.append(f"--checkpoint_dir={FLAGS.checkpoint_dir}")
+        if FLAGS.step is not None:
+            cmd.append(f"--step={FLAGS.step}")
+        if FLAGS.platform:
+            cmd.append(f"--platform={FLAGS.platform}")
+        if FLAGS.host_device_count:
+            cmd.append(f"--host_device_count={FLAGS.host_device_count}")
+        if FLAGS.compile_cache_dir:
+            cmd.append(f"--compile_cache_dir={FLAGS.compile_cache_dir}")
+        if FLAGS.fault_plan:
+            cmd.append(f"--fault_plan={FLAGS.fault_plan}")
+        if FLAGS.mesh:
+            cmd.append(f"--mesh={FLAGS.mesh}")
+        env = dict(os.environ)
+        env[events_mod.ENV_HOST_ID] = str(i)
+        if FLAGS.journal:
+            env[events_mod.ENV_JOURNAL] = FLAGS.journal
+        proc = subprocess.Popen(cmd, env=env)
+        procs.append(proc)
+        _LIVE_REPLICA_PROCS.append(proc)
+        urls[i] = f"http://127.0.0.1:{port}"
+        log.info("spawned replica %d (pid %d) on %s", i, proc.pid, urls[i])
+
+    deadline = time.monotonic() + 180.0  # cold jax import + prewarm compiles
+    for i, proc in enumerate(procs):
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {i} exited rc={proc.returncode} before serving")
+            try:
+                with urllib.request.urlopen(urls[i] + "/healthz",
+                                            timeout=2.0) as r:
+                    if json.loads(r.read()).get("state") == "serving":
+                        break
+            except OSError:
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"replica {i} not serving within budget")
+            time.sleep(0.25)
+    replicas = [HttpReplica(i, urls[i], capacity_hint=FLAGS.queue_depth)
+                for i in sorted(urls)]
+    return procs, urls, replicas
+
+
+def _build_inprocess_replicas(n: int):
+    """N `InProcessReplica`s over one mesh + one shared compile cache."""
+    from dist_mnist_tpu.cluster import initialize_distributed
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.obs import HealthState
+    from dist_mnist_tpu.serve import (
+        CompiledModelCache,
+        InferenceEngine,
+        InferenceServer,
+        InProcessReplica,
+        ServeConfig,
+        load_for_serving,
+    )
+
+    initialize_distributed(
+        None, 1, 0,
+        platform=FLAGS.platform, host_device_count=FLAGS.host_device_count,
+    )
+    cfg = get_config(FLAGS.config)
+    spec = cfg.mesh
+    if FLAGS.mesh:
+        kv = dict(part.split("=") for part in FLAGS.mesh.split(","))
+        spec = MeshSpec(**{k: int(v) for k, v in kv.items()})
+    mesh = make_mesh(spec)
+    bundle = load_for_serving(
+        cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step)
+    store = None
+    if FLAGS.compile_cache_dir:
+        from pathlib import Path
+
+        from dist_mnist_tpu.compilecache import ExecutableStore
+
+        store = ExecutableStore(Path(FLAGS.compile_cache_dir) / "exe")
+    shared_cache = CompiledModelCache(store=store)
+    plan = None
+    if FLAGS.fault_plan:
+        from dist_mnist_tpu.faults import FaultPlan
+
+        plan = FaultPlan.from_spec(FLAGS.fault_plan)
+
+    def make_server_factory(replica_id: int):
+        def make_server():
+            engine = InferenceEngine(
+                bundle.model, bundle.params, bundle.model_state, mesh,
+                model_name=cfg.model, image_shape=bundle.image_shape,
+                rules=bundle.rules, max_bucket=max(FLAGS.max_batch, 1),
+                cache=shared_cache,
+            )
+            if plan is not None:
+                engine = plan.wrap_engine(engine, replica_id=replica_id)
+            return InferenceServer(
+                engine,
+                ServeConfig(max_batch=FLAGS.max_batch,
+                            max_wait_ms=FLAGS.max_wait_ms,
+                            queue_depth=FLAGS.queue_depth),
+                health=HealthState(),
+            ).start()
+
+        return make_server
+
+    def load_weights(step: int):
+        new = load_for_serving(
+            cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=step)
+        if not new.restored:
+            raise FileNotFoundError(f"no committed checkpoint at step {step}")
+        return new.params, new.model_state
+
+    replicas = [
+        InProcessReplica(i, make_server_factory(i),
+                         load_weights=load_weights if FLAGS.checkpoint_dir
+                         else None).start()
+        for i in range(n)
+    ]
+    return bundle, replicas
+
+
+def main(argv):
+    del argv
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+    )
+    logging.getLogger("absl").setLevel(logging.WARNING)
+
+    import os
+    import signal
+
+    from dist_mnist_tpu.obs import (
+        FleetScraper,
+        HealthState,
+        MetricRegistry,
+        MetricsExporter,
+        RunJournal,
+    )
+    from dist_mnist_tpu.obs import events as events_mod
+    from dist_mnist_tpu.serve import (
+        CheckpointWatcher,
+        Router,
+        RouterConfig,
+        run_fleet_loadgen,
+    )
+
+    registry = MetricRegistry()
+    health = HealthState(
+        generation=int(os.environ.get(events_mod.ENV_GENERATION, "0")))
+    journal_path = (FLAGS.journal or os.environ.get(events_mod.ENV_JOURNAL))
+    journal = (RunJournal(journal_path, generation=health.generation)
+               if journal_path else None)
+    if journal is not None:
+        events_mod.set_journal(journal)
+
+    procs: list = []
+    scraper = None
+    exporter = None
+    watcher = None
+    router = None
+    replicas: list = []
+    try:
+        if FLAGS.inprocess:
+            bundle, replicas = _build_inprocess_replicas(FLAGS.replicas)
+            image_shape = bundle.image_shape
+            initial_step = bundle.step
+        else:
+            procs, urls, replicas = _spawn_replicas(FLAGS.replicas)
+            from dist_mnist_tpu.configs import get_config
+            from dist_mnist_tpu.data.datasets import DATASETS
+
+            cfg = get_config(FLAGS.config)
+            image_shape = tuple(DATASETS[cfg.dataset]["image_shape"])
+            initial_step = FLAGS.step
+            # PR 9's cross-host poller, retargeted at the serving fleet:
+            # merged replica /metrics (incl. serve/ latency ladders) on
+            # this process's exporter, plus /fleet JSON
+            scraper = FleetScraper(journal=journal, interval_s=0.5)
+            scraper.set_targets(urls)
+            scraper.start()
+
+        if FLAGS.metrics_port:
+            try:
+                exporter = MetricsExporter(
+                    registry, health=health, journal_path=journal_path,
+                    port=FLAGS.metrics_port,
+                    info={"host_id": os.environ.get(events_mod.ENV_HOST_ID,
+                                                    "0"),
+                          "generation": str(health.generation),
+                          "role": "router"},
+                    fleet=scraper,
+                ).start()
+            except OSError as e:
+                log.warning("metrics exporter: could not bind port %d (%s)",
+                            FLAGS.metrics_port, e)
+
+        router = Router(
+            replicas,
+            RouterConfig(
+                hedge_after_ms=FLAGS.hedge_after_ms or None,
+                health_interval_s=FLAGS.health_interval_s,
+            ),
+            registry=registry,
+        ).start()
+        health.set("serving")
+
+        if FLAGS.watch:
+            if not FLAGS.checkpoint_dir:
+                raise app.UsageError("--watch requires --checkpoint_dir")
+            watcher = CheckpointWatcher(
+                FLAGS.checkpoint_dir, router.roll_weights,
+                poll_interval_s=FLAGS.watch_interval_s,
+                initial_step=initial_step,
+            ).start()
+
+        summary = run_fleet_loadgen(
+            router,
+            n_requests=FLAGS.requests,
+            concurrency=FLAGS.concurrency,
+            image_shape=image_shape,
+            seed=FLAGS.seed,
+            ls_fraction=FLAGS.ls_fraction,
+            ls_deadline_ms=FLAGS.ls_deadline_ms or None,
+            be_deadline_ms=FLAGS.be_deadline_ms or None,
+        )
+        summary["replicas"] = FLAGS.replicas
+        summary["inprocess"] = FLAGS.inprocess
+        summary["serving_step"] = router.serving_step
+        if watcher is not None:
+            summary["watcher"] = {"polls": watcher.polls,
+                                  "rolls": watcher.rolls}
+    finally:
+        if watcher is not None:
+            watcher.close()
+        if router is not None:
+            router.close()
+        for r in replicas:
+            try:
+                r.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                log.warning("replica close failed", exc_info=True)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+                proc.wait(timeout=5)
+            if proc in _LIVE_REPLICA_PROCS:
+                _LIVE_REPLICA_PROCS.remove(proc)
+        if scraper is not None:
+            scraper.close()
+        if exporter is not None:
+            exporter.close()
+        if journal is not None:
+            events_mod.set_journal(None)
+            journal.close()
+    print(json.dumps(summary, indent=2, sort_keys=True))
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -4,11 +4,19 @@ with the deterministic load generator, print a latency/batching summary.
 `python -m dist_mnist_tpu.cli.serve --config=mlp_mnist \
     --checkpoint_dir=/tmp/ckpt --platform=cpu --host_device_count=8`
 
-There is deliberately no network listener here: the transport (gRPC/HTTP)
-is deployment-specific and trivial next to the hard parts — batching,
-compilation policy, admission — which this driver exercises end to end
-and docs/SERVING.md specifies. `InferenceServer.submit` IS the serving
-API; a transport shim maps one RPC to one submit().
+Two modes:
+
+- default: drive the server with the deterministic load generator and
+  exit — the transport-free latency/batching harness.
+- ``--serve_forever``: run as one FLEET REPLICA until SIGTERM/SIGINT.
+  The metrics exporter doubles as the data plane (obs/exporter.py
+  do_POST): POST /predict executes one inference, POST /swap quiesces
+  and hot-swaps to a committed checkpoint step, and /healthz carries the
+  serving -> draining state a `serve/router.py` Router probes. This is
+  the process `cli/router.py` spawns N of.
+
+`InferenceServer.submit` IS the serving API either way; the HTTP shim
+maps one RPC to one submit().
 """
 
 from __future__ import annotations
@@ -61,6 +69,67 @@ flags.DEFINE_string("journal", None,
                     "append-only JSONL run-journal path (obs/events.py); "
                     "defaults to $DIST_MNIST_TPU_JOURNAL, else "
                     "<logdir>/events.jsonl when --logdir is set")
+# -- fleet-replica mode -------------------------------------------------------
+flags.DEFINE_boolean("serve_forever", False,
+                     "run as a fleet replica until SIGTERM/SIGINT: the "
+                     "metrics exporter serves POST /predict and /swap next "
+                     "to /healthz + /metrics (requires --metrics_port); no "
+                     "loadgen runs (cli/router.py drives the traffic)")
+flags.DEFINE_integer("replica_id", None,
+                     "this replica's id in the fleet (scopes "
+                     "serve_replica_* faults in --fault_plan); defaults to "
+                     "$DIST_MNIST_TPU_HOST_ID, else 0")
+
+
+def _serve_forever(server, exporter, cfg, mesh) -> dict:
+    """Replica mode: wire the exporter's data plane to this server and
+    block until SIGTERM/SIGINT. `predict_fn` maps one POST to one
+    submit(); `swap_fn` quiesces the pipeline (the router already stopped
+    routing here) and hot-swaps to the requested committed step via the
+    same `load_for_serving` path the process booted through."""
+    import signal
+    import threading
+
+    from dist_mnist_tpu.serve import load_for_serving
+
+    def predict_fn(image, deadline_ms):
+        fut = server.submit(image, deadline_ms=deadline_ms)
+        # bound the HTTP worker's wait: the request's own deadline plus
+        # slack for the batch in front of it, or a generous idle ceiling
+        wait_s = (deadline_ms / 1e3 + 30.0) if deadline_ms else 60.0
+        return fut.result(timeout=wait_s)
+
+    swap_lock = threading.Lock()
+
+    def swap_fn(step: int) -> dict:
+        with swap_lock:
+            if not server.quiesce(timeout=30.0):
+                raise TimeoutError("pipeline did not quiesce for swap")
+            new = load_for_serving(
+                cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=step)
+            if not new.restored:
+                raise FileNotFoundError(
+                    f"no committed checkpoint at step {step}")
+            server.engine.swap_weights(new.params, new.model_state,
+                                       version=step)
+            return {"swapped": True, "step": step}
+
+    exporter.predict_fn = predict_fn
+    exporter.swap_fn = swap_fn
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    with server:
+        log.info("replica serving on %s (SIGTERM to stop)",
+                 exporter.url("/predict"))
+        stop.wait()
+        # stop accepting POSTs before the pipeline drains
+        exporter.predict_fn = None
+        exporter.swap_fn = None
+    summary = server.stats()
+    summary["weights_version"] = server.engine.weights_version
+    return summary
 
 
 def main(argv):
@@ -114,8 +183,12 @@ def main(argv):
                 },
             ).start()
         except OSError as e:
+            if FLAGS.serve_forever:
+                raise  # the exporter IS the replica's data plane
             log.warning("metrics exporter: could not bind port %d (%s); "
                         "continuing without exposition", FLAGS.metrics_port, e)
+    if FLAGS.serve_forever and exporter is None:
+        raise app.UsageError("--serve_forever requires --metrics_port")
 
     initialize_distributed(
         None, 1, 0,
@@ -152,7 +225,11 @@ def main(argv):
     if FLAGS.fault_plan:
         from dist_mnist_tpu.faults import FaultPlan
 
-        engine = FaultPlan.from_spec(FLAGS.fault_plan).wrap_engine(engine)
+        replica_id = (FLAGS.replica_id if FLAGS.replica_id is not None
+                      else int(os.environ.get(events_mod.ENV_HOST_ID, "0")
+                               or 0))
+        engine = FaultPlan.from_spec(FLAGS.fault_plan).wrap_engine(
+            engine, replica_id=replica_id)
     writer = make_default_writer(FLAGS.logdir, registry=registry)
     server = InferenceServer(
         engine,
@@ -169,14 +246,17 @@ def main(argv):
     # live full-distribution exposition of the serve ladders (/metrics)
     server.metrics.attach_to(registry)
     try:
-        with server:
-            summary = run_loadgen(
-                server,
-                n_requests=FLAGS.requests,
-                concurrency=FLAGS.concurrency,
-                image_shape=bundle.image_shape,
-                seed=FLAGS.seed,
-            )
+        if FLAGS.serve_forever:
+            summary = _serve_forever(server, exporter, cfg, mesh)
+        else:
+            with server:
+                summary = run_loadgen(
+                    server,
+                    n_requests=FLAGS.requests,
+                    concurrency=FLAGS.concurrency,
+                    image_shape=bundle.image_shape,
+                    seed=FLAGS.seed,
+                )
     finally:
         if exporter is not None:
             exporter.close()

@@ -204,18 +204,69 @@ class FaultyCheckpointManager:
 class FaultyEngine:
     """Serve-engine wrapper raising on a planned predict-call ordinal.
 
-    The DynamicBatcher above it must fail only that batch's futures and
-    keep serving (serve/batcher.py) — this shim makes that isolation
-    testable without a real device error."""
+    Three kinds, three failure shapes the layers above must absorb:
 
-    def __init__(self, inner, plan: FaultPlan):
+    - ``serve_error``: one transient raise — the DynamicBatcher must fail
+      only that batch's futures and keep serving (serve/batcher.py), and
+      a router classifies it RETRYABLE (serve/errors.py).
+    - ``serve_replica_kill`` (scoped by ``replica_id``): the engine goes
+      PERMANENTLY dead — the fired call and every call after it raise
+      ReplicaKilledError, like a device loss under a live server. The
+      batcher keeps failing batches; only a router failing over (and a
+      `restart()` building a FRESH engine) recovers.
+    - ``serve_replica_stall`` (scoped): one sleep inside predict — a
+      straggler that stretches a whole batch's latency, which is what a
+      router's hedged requests exist to cut off.
+
+    Ordinals count THIS engine's predict calls (each replica has its own
+    clock), so one shared plan targets replicas independently.
+    """
+
+    def __init__(self, inner, plan: FaultPlan, *, replica_id: int | None = None):
         self._inner = inner
         self._plan = plan
+        self._replica_id = replica_id
         self._calls = 0
+        self._dead = False
+
+    def _mine(self, kind: str):
+        return [f for f in self._plan.pending(kind)
+                if f.replica is None or f.replica == self._replica_id]
 
     def predict(self, *args, **kwargs):
+        from dist_mnist_tpu.serve.errors import ReplicaKilledError
+
+        if self._dead:
+            raise ReplicaKilledError(
+                f"replica {self._replica_id}: engine is dead (injected kill)"
+            )
         call = self._calls
         self._calls += 1
+        for f in self._mine("serve_replica_kill"):
+            if f.request is not None and call >= f.request:
+                f.fired = True
+                self._dead = True
+                log.warning(
+                    "fault injected: replica %s killed on predict call %d",
+                    self._replica_id, call,
+                )
+                events.emit("fault_injected", kind="serve_replica_kill",
+                            replica=self._replica_id, call=call)
+                raise ReplicaKilledError(
+                    f"replica {self._replica_id}: injected kill on predict "
+                    f"call {call}"
+                )
+        for f in self._mine("serve_replica_stall"):
+            if f.request is not None and call >= f.request:
+                f.fired = True
+                log.warning(
+                    "fault injected: replica %s stalls %.2fs on predict "
+                    "call %d", self._replica_id, f.seconds or 0.0, call,
+                )
+                events.emit("fault_injected", kind="serve_replica_stall",
+                            replica=self._replica_id, call=call,
+                            seconds=f.seconds or 0.0)
+                time.sleep(f.seconds or 0.0)
         for f in self._plan.pending("serve_error"):
             if f.request is not None and call >= f.request:
                 f.fired = True

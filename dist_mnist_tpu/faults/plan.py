@@ -26,6 +26,16 @@ kill_host           loop step >= ``step``,     FaultInjectionHook on the
                                                ``recover_after_s`` elapses)
 serve_error         predict call >= ``request``FaultyEngine (raises into
                                                the DynamicBatcher)
+serve_replica_kill  predict call >= ``request``FaultyEngine on replica
+                    on replica ``replica``     ``replica`` (engine goes
+                                               PERMANENTLY dead: every
+                                               later predict raises
+                                               ReplicaKilledError — the
+                                               router must fail over)
+serve_replica_stall predict call >= ``request``FaultyEngine on replica
+                    on replica ``replica``     ``replica`` (sleeps
+                                               ``seconds`` once — the
+                                               router's hedge trigger)
 =================== ========================== ==========================
 
 ``kill_host`` vs ``kill_process``: a kill_process is a transient crash —
@@ -62,6 +72,8 @@ KINDS = (
     "kill_process",
     "kill_host",
     "serve_error",
+    "serve_replica_kill",
+    "serve_replica_stall",
 )
 
 
@@ -73,6 +85,7 @@ class Fault:
     process: int | None = None  # kill_process target index
     after_s: float | None = None  # kill_process delay after spawn
     request: int | None = None  # serve_error predict-call ordinal (0-based)
+    replica: int | None = None  # serve_replica_* target replica id
     recover_after_s: float | None = None  # kill_host: planned recovery delay
     mode: str = "truncate"  # corrupt_checkpoint: truncate | delete
     fired: bool = False  # latched by the consumer on injection
@@ -122,6 +135,22 @@ class Fault:
     def serve_error(cls, request: int = 0) -> "Fault":
         return cls("serve_error", request=request)
 
+    @classmethod
+    def serve_replica_kill(cls, replica: int, request: int = 0) -> "Fault":
+        """Replica ``replica``'s engine dies permanently on predict call
+        ``request`` (its ordinal, not the fleet's) — every later predict
+        raises ReplicaKilledError, like a device loss under a live server."""
+        return cls("serve_replica_kill", replica=replica, request=request)
+
+    @classmethod
+    def serve_replica_stall(cls, replica: int, seconds: float,
+                            request: int = 0) -> "Fault":
+        """Replica ``replica`` sleeps ``seconds`` inside predict call
+        ``request`` (once) — a straggler, not a death; what a router's
+        hedged requests are for."""
+        return cls("serve_replica_stall", replica=replica, seconds=seconds,
+                   request=request)
+
     def to_dict(self) -> dict:
         out = {"kind": self.kind}
         for field in (
@@ -130,6 +159,7 @@ class Fault:
             "process",
             "after_s",
             "request",
+            "replica",
             "recover_after_s",
         ):
             v = getattr(self, field)
@@ -222,12 +252,20 @@ class FaultPlan:
 
         return FaultyCheckpointManager(manager, self)
 
-    def wrap_engine(self, engine):
-        if not self.pending("serve_error"):
+    def wrap_engine(self, engine, *, replica_id: int | None = None):
+        """Wrap a serve engine when any serve-side fault is pending.
+        ``replica_id`` scopes the replica-targeted kinds: a fleet shares
+        ONE plan, and each replica's engine consumes only the faults whose
+        ``replica`` matches (plain ``serve_error`` matches any)."""
+        if not any(
+            self.pending(k)
+            for k in ("serve_error", "serve_replica_kill",
+                      "serve_replica_stall")
+        ):
             return engine
         from dist_mnist_tpu.faults.inject import FaultyEngine
 
-        return FaultyEngine(engine, self)
+        return FaultyEngine(engine, self, replica_id=replica_id)
 
     def wrap_step_fn(self, step_fn, *, initial_step: int = 0):
         from dist_mnist_tpu.faults.inject import FaultyStepFn

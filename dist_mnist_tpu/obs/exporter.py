@@ -233,13 +233,94 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:  # client already gone
                 pass
 
+    # -- serve data plane (POST) ----------------------------------------------
+    # Wired only when the owning process is a serve replica
+    # (cli/serve.py --serve_forever): /predict executes one inference via
+    # exporter.predict_fn, /swap rolls the replica's weights via
+    # exporter.swap_fn. Errors map to the typed statuses serve/router.py's
+    # HttpReplica reconstructs (429 queue full, 503 shutting down, 504
+    # deadline, 500 + error type otherwise), so a remote replica fails
+    # EXACTLY like an in-process one under classify_failure.
+
+    def _send_serve_error(self, err: BaseException) -> None:
+        from dist_mnist_tpu.serve.admission import (
+            DeadlineExceededError,
+            QueueFullError,
+            ShuttingDownError,
+        )
+
+        code = 500
+        if isinstance(err, DeadlineExceededError):
+            code = 504  # before QueueFull/Shutdown: it's the narrow type
+        elif isinstance(err, QueueFullError):
+            code = 429
+        elif isinstance(err, ShuttingDownError):
+            code = 503
+        self._send(code, json.dumps(
+            {"error": type(err).__name__, "message": str(err)}),
+            "application/json")
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        try:
+            url = urlparse(self.path)
+            exp = self.exporter
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            body = self.rfile.read(length) if length else b""
+            if url.path == "/predict":
+                if exp.predict_fn is None:
+                    self._send(404, "not a serve replica\n", "text/plain")
+                    return
+                import io as _io
+
+                import numpy as _np
+
+                q = parse_qs(url.query).get("deadline_ms", [None])[0]
+                deadline_ms = float(q) if q not in (None, "", "None") else None
+                image = _np.load(_io.BytesIO(body), allow_pickle=False)
+                try:
+                    res = exp.predict_fn(image, deadline_ms)
+                except Exception as err:  # noqa: BLE001 - typed status below
+                    self._send_serve_error(err)
+                    return
+                self._send(200, json.dumps({
+                    "logits": _np.asarray(res.logits, dtype=float).tolist(),
+                    "label": int(res.label),
+                    "latency_ms": float(res.latency_ms),
+                }), "application/json")
+            elif url.path == "/swap":
+                if exp.swap_fn is None:
+                    self._send(404, "not a serve replica\n", "text/plain")
+                    return
+                step = int(parse_qs(url.query).get("step", ["-1"])[0])
+                try:
+                    out = exp.swap_fn(step)
+                except Exception as err:  # noqa: BLE001 - typed status below
+                    self._send_serve_error(err)
+                    return
+                self._send(200, json.dumps(
+                    {"step": step, "result": out}, default=str),
+                    "application/json")
+            else:
+                self._send(404, "not found\n", "text/plain")
+        except Exception:  # noqa: BLE001 - never kill the serve thread
+            log.warning("exporter POST failed", exc_info=True)
+            try:
+                self._send(500, "internal error\n", "text/plain")
+            except Exception:  # client already gone
+                pass
+
 
 class MetricsExporter:
-    """Background /metrics + /healthz + /events server for one process."""
+    """Background /metrics + /healthz + /events server for one process.
+
+    With ``predict_fn``/``swap_fn`` wired it is also a serve replica's
+    data plane: POST /predict and /swap next to the observability
+    endpoints, one port per replica (see _Handler.do_POST)."""
 
     def __init__(self, registry=None, *, health: HealthState | None = None,
                  journal_path=None, port: int = 0, host: str = "127.0.0.1",
-                 info: dict | None = None, fleet=None):
+                 info: dict | None = None, fleet=None,
+                 predict_fn=None, swap_fn=None):
         self.registry = registry
         self.health = health
         self.journal_path = journal_path
@@ -248,6 +329,10 @@ class MetricsExporter:
         # optional obs/fleet.FleetScraper: merged fleet series on /metrics
         # plus the /fleet JSON endpoint
         self.fleet = fleet
+        # serve data plane: (image, deadline_ms) -> InferenceResult, and
+        # step -> swap result; both None on pure-observability processes
+        self.predict_fn = predict_fn
+        self.swap_fn = swap_fn
         self.host = host
         self.port = int(port)
         self._server: ThreadingHTTPServer | None = None

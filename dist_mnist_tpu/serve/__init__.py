@@ -15,7 +15,10 @@ Layering (each module depends only on those above it):
     batcher.py    the coalescing loop (one daemon thread)
     loader.py     checkpoint -> (model, params, model_state), no optimizer
     server.py     InferenceServer facade wiring all of the above
-    loadgen.py    deterministic closed-loop load generator (bench + tests)
+    errors.py     failure taxonomy: retryable / terminal / replica-fatal
+    router.py     fleet facade: N replicas, tiered shedding, failover,
+                  hedging, zero-downtime weight hot-swap
+    loadgen.py    deterministic closed-loop load generators (bench + tests)
 """
 
 from dist_mnist_tpu.serve.admission import (
@@ -25,21 +28,48 @@ from dist_mnist_tpu.serve.admission import (
     ShuttingDownError,
 )
 from dist_mnist_tpu.serve.engine import CompiledModelCache, InferenceEngine
+from dist_mnist_tpu.serve.errors import (
+    AllReplicasDownError,
+    ReplicaKilledError,
+    ShedError,
+    classify_failure,
+)
 from dist_mnist_tpu.serve.loader import load_for_serving
-from dist_mnist_tpu.serve.loadgen import run_loadgen
+from dist_mnist_tpu.serve.loadgen import run_fleet_loadgen, run_loadgen
 from dist_mnist_tpu.serve.metrics import ServeMetrics
+from dist_mnist_tpu.serve.router import (
+    BEST_EFFORT,
+    LATENCY_SENSITIVE,
+    CheckpointWatcher,
+    HttpReplica,
+    InProcessReplica,
+    Router,
+    RouterConfig,
+)
 from dist_mnist_tpu.serve.server import InferenceServer, ServeConfig
 
 __all__ = [
     "AdmissionQueue",
+    "AllReplicasDownError",
+    "BEST_EFFORT",
+    "CheckpointWatcher",
     "CompiledModelCache",
     "DeadlineExceededError",
+    "HttpReplica",
+    "InProcessReplica",
     "InferenceEngine",
     "InferenceServer",
+    "LATENCY_SENSITIVE",
     "QueueFullError",
+    "ReplicaKilledError",
+    "Router",
+    "RouterConfig",
     "ServeConfig",
     "ServeMetrics",
+    "ShedError",
     "ShuttingDownError",
+    "classify_failure",
     "load_for_serving",
+    "run_fleet_loadgen",
     "run_loadgen",
 ]

@@ -42,9 +42,16 @@ class Request:
     future: Future
     t_submit: float  # time.monotonic() at admission
     deadline: float | None  # absolute monotonic instant, None = no deadline
+    # optional threading.Event a router sets to withdraw the request (hedge
+    # loser cancellation); checked at dequeue like the deadline
+    cancel_event: threading.Event | None = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
+
+    @property
+    def cancelled(self) -> bool:
+        return self.cancel_event is not None and self.cancel_event.is_set()
 
 
 @dataclasses.dataclass
@@ -65,7 +72,8 @@ class AdmissionQueue:
         self._closed = threading.Event()
 
     def submit(self, image: np.ndarray, *,
-               deadline_ms: float | None = None) -> Future:
+               deadline_ms: float | None = None,
+               cancel_event: threading.Event | None = None) -> Future:
         """Admit one request; returns a Future resolving to an
         InferenceResult. Raises instead of blocking when the server is
         draining or the queue is full — admission never stalls a client."""
@@ -78,6 +86,7 @@ class AdmissionQueue:
             future=Future(),
             t_submit=now,
             deadline=now + deadline_ms / 1e3 if deadline_ms is not None else None,
+            cancel_event=cancel_event,
         )
         try:
             self._q.put_nowait(req)

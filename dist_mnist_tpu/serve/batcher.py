@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from concurrent.futures import CancelledError
 
 import numpy as np
 
@@ -87,7 +88,13 @@ class DynamicBatcher:
         now = time.monotonic()
         live: list[Request] = []
         for req in batch:
-            if req.expired(now):
+            if req.cancelled:
+                # hedge loser withdrawn before execution (admission.py's
+                # cancel_event contract): never occupies a batch slot
+                self.metrics.record_cancelled()
+                req.future.set_exception(CancelledError(
+                    "request cancelled before execution"))
+            elif req.expired(now):
                 self.metrics.record_rejected("deadline")
                 req.future.set_exception(DeadlineExceededError(
                     f"expired in queue after "

@@ -148,12 +148,18 @@ class InferenceEngine:
         rules: ShardingRules = DP_RULES,
         max_bucket: int = 256,
         store=None,
+        cache: CompiledModelCache | None = None,
     ):
         self.model = model
         self.mesh = mesh
         self.model_name = model_name
         self.image_shape = tuple(image_shape)
-        self.cache = CompiledModelCache(store=store)
+        # `cache` lets N same-model replicas share one CompiledModelCache:
+        # executables take (params, model_state, x) as runtime arguments, so
+        # a program compiled by replica 0 serves replica 1's weights too —
+        # the fleet pays log2(max_batch) compiles once, not per replica.
+        # A provided cache keeps ITS store; `store` only seeds a fresh one.
+        self.cache = cache if cache is not None else CompiledModelCache(store=store)
         self._rules = rules
         # buckets must divide over the data axis; the smallest power of two
         # >= the axis size always does (the axis size is itself a device
@@ -167,6 +173,48 @@ class InferenceEngine:
         self._ms_shd = tree_sharding(model_state, mesh, rules)
         self.params = jax.device_put(params, self._param_shd)
         self.model_state = jax.device_put(model_state, self._ms_shd)
+        #: version tag of the weights currently served (a train step after a
+        #: hot swap; 0 for the construction-time weights)
+        self.weights_version = 0
+
+    # -- hot swap ------------------------------------------------------------
+    def swap_weights(self, params, model_state, *, version: int | None = None,
+                     ) -> None:
+        """Replace the served weights IN PLACE, without recompilation.
+
+        The compiled executables take ``(params, model_state, x)`` as
+        runtime arguments (see `_compile`), so new same-shaped weights run
+        under the exact programs already cached — a weight rollout costs a
+        device_put, never an XLA compile. Placement reuses the
+        construction-time shardings, and the swap is all-or-nothing: both
+        trees are validated (structure + per-leaf shape) and fully
+        transferred BEFORE the engine pointers move, so any failure leaves
+        the old weights serving untouched — which is what makes a kill
+        mid-swap recoverable (docs/SERVING.md "Fleet router").
+
+        A batch already executing keeps its references to the old arrays
+        (the arguments were captured at call time); the swap is only
+        *observable* from the next `predict`.
+        """
+
+        def _check(old, new):
+            if tuple(old.shape) != tuple(jnp.shape(new)):
+                raise ValueError(
+                    f"swap shape mismatch: {tuple(old.shape)} vs "
+                    f"{tuple(jnp.shape(new))}"
+                )
+            return None
+
+        jax.tree.map(_check, self.params, params)  # raises on tree mismatch
+        jax.tree.map(_check, self.model_state, model_state)
+        new_p = jax.device_put(params, self._param_shd)
+        new_ms = jax.device_put(model_state, self._ms_shd)
+        jax.block_until_ready((new_p, new_ms))  # fail HERE, not mid-predict
+        self.params = new_p
+        self.model_state = new_ms
+        if version is not None:
+            self.weights_version = int(version)
+        log.info("swapped weights (version=%s)", self.weights_version)
 
     # -- bucketing -----------------------------------------------------------
     def bucket_for(self, n: int) -> int:

@@ -13,11 +13,18 @@ same order.
 Used by three consumers with one definition: `scripts/serve_loadgen.py`
 (CLI), `bench.py --serve` (the serve_p99_latency_ms BENCH metric), and
 tests/test_serve.py (the acceptance path).
+
+`run_fleet_loadgen` is the two-class variant for a `serve/router.py`
+Router: a seeded latency_sensitive/best_effort class sequence with
+per-class deadlines, and per-class accounting that separates the
+outcomes the tier policy is allowed to produce (best_effort shed) from
+the ones it must not (latency_sensitive errors or drops).
 """
 
 from __future__ import annotations
 
 import threading
+from concurrent.futures import TimeoutError as _FuturesTimeout
 
 import numpy as np
 
@@ -108,4 +115,125 @@ def run_loadgen(
     summary["mean_occupancy"] = stats["mean_occupancy"]
     summary["n_batches"] = stats["n_batches"]
     summary["cache"] = stats["cache"]
+    return summary
+
+
+def _pct(lat: np.ndarray) -> dict:
+    if not lat.size:
+        return {"p50_ms": float("nan"), "p95_ms": float("nan"),
+                "p99_ms": float("nan"), "mean_ms": float("nan")}
+    return {
+        "p50_ms": float(np.percentile(lat, 50)),
+        "p95_ms": float(np.percentile(lat, 95)),
+        "p99_ms": float(np.percentile(lat, 99)),
+        "mean_ms": float(lat.mean()),
+    }
+
+
+def run_fleet_loadgen(
+    router,
+    *,
+    n_requests: int,
+    concurrency: int,
+    image_shape: tuple[int, ...],
+    seed: int = 0,
+    ls_fraction: float = 0.8,
+    ls_deadline_ms: float | None = None,
+    be_deadline_ms: float | None = None,
+    timeout: float = 180.0,
+    keep_latencies: bool = False,
+) -> dict:
+    """Drive a `Router` with seeded two-class traffic; per-class summary.
+
+    The class sequence is a fixed function of (seed, n_requests,
+    ls_fraction), so two runs offer byte-identical traffic in the same
+    order — which is what lets a fault-injected run be compared against
+    a clean one request-for-request. Outcome taxonomy per class:
+    `ok` / `shed` (router tier policy — only legitimate for best_effort) /
+    `rejected` (queue-full / shutdown / all-down at submit) /
+    `deadline_expired` / `errors` (post-admission failures) / `dropped`
+    (future never settled inside `timeout` — always a bug).
+    """
+    from dist_mnist_tpu.serve.errors import AllReplicasDownError, ShedError
+    from dist_mnist_tpu.serve.router import (
+        BEST_EFFORT,
+        LATENCY_SENSITIVE,
+        REQUEST_CLASSES,
+    )
+
+    images = make_images(image_shape, seed=seed)
+    rng = np.random.default_rng(seed)
+    classes = np.where(rng.random(n_requests) < ls_fraction,
+                       LATENCY_SENSITIVE, BEST_EFFORT)
+    deadline_for = {LATENCY_SENSITIVE: ls_deadline_ms,
+                    BEST_EFFORT: be_deadline_ms}
+    window = threading.Semaphore(concurrency)
+    futures: list = []  # (class, future)
+    shed = {c: 0 for c in REQUEST_CLASSES}
+    rejected = {c: 0 for c in REQUEST_CLASSES}
+
+    for i in range(n_requests):
+        cls = str(classes[i])
+        window.acquire()
+        try:
+            fut = router.submit(images[i % len(images)], request_class=cls,
+                                deadline_ms=deadline_for[cls])
+        except ShedError:
+            shed[cls] += 1
+            window.release()
+            continue
+        except (QueueFullError, ShuttingDownError, AllReplicasDownError):
+            rejected[cls] += 1
+            window.release()
+            continue
+        fut.add_done_callback(lambda _f: window.release())
+        futures.append((cls, fut))
+
+    import time as _t
+
+    gather_deadline = _t.monotonic() + timeout
+    ok = {c: 0 for c in REQUEST_CLASSES}
+    deadline_expired = {c: 0 for c in REQUEST_CLASSES}
+    errors = {c: 0 for c in REQUEST_CLASSES}
+    dropped = {c: 0 for c in REQUEST_CLASSES}
+    latencies = {c: [] for c in REQUEST_CLASSES}
+    for cls, fut in futures:
+        remaining = gather_deadline - _t.monotonic()
+        try:
+            res = fut.result(timeout=max(remaining, 0.001))
+        except DeadlineExceededError:
+            deadline_expired[cls] += 1
+            continue
+        except (TimeoutError, _FuturesTimeout):
+            # the future itself never settled — an in-flight request was
+            # dropped on the floor somewhere, which the router contract
+            # forbids; surfaced separately so tests can pin dropped == 0
+            dropped[cls] += 1
+            continue
+        except Exception:
+            errors[cls] += 1
+            continue
+        ok[cls] += 1
+        latencies[cls].append(res.latency_ms)
+
+    summary: dict = {
+        "n_requests": n_requests,
+        "concurrency": concurrency,
+        "ls_fraction": ls_fraction,
+        "offered": {c: int((classes == c).sum()) for c in REQUEST_CLASSES},
+        "ok": ok,
+        "shed": shed,
+        "rejected": rejected,
+        "deadline_expired": deadline_expired,
+        "errors": errors,
+        "dropped": dropped,
+    }
+    for cls in REQUEST_CLASSES:
+        summary[f"latency_{cls}"] = _pct(
+            np.asarray(latencies[cls], dtype=np.float64))
+    summary["total_ok"] = sum(ok.values())
+    summary["router"] = router.metrics.snapshot()
+    if keep_latencies:
+        summary["raw_latencies"] = {c: list(latencies[c])
+                                    for c in REQUEST_CLASSES}
     return summary

@@ -24,7 +24,7 @@ class ServeMetrics:
     """Thread-safe accumulator for one server's lifetime.
 
     Counters:   admitted, completed, rejected_queue_full, rejected_deadline,
-                rejected_shutdown, failed.
+                rejected_shutdown, failed, cancelled.
     Histograms: request latency (ms, submit->result), executed batch sizes
                 (real rows), bucket occupancy (real rows / padded bucket).
     """
@@ -37,6 +37,7 @@ class ServeMetrics:
         self.rejected_deadline = 0
         self.rejected_shutdown = 0
         self.failed = 0
+        self.cancelled = 0
         # own ladders per signal: latency spans µs..minutes; batch size is
         # small integers; occupancy lives in (0, 1]
         self.latency_ms = StreamingHistogram()
@@ -69,6 +70,21 @@ class ServeMetrics:
         with self._lock:
             self.failed += n
 
+    def record_cancelled(self, n: int = 1):
+        with self._lock:
+            self.cancelled += n
+
+    @property
+    def inflight(self) -> int:
+        """Admitted requests whose futures have not settled yet (queued or
+        mid-batch) — the quantity `InferenceServer.quiesce` waits on.
+        Admission-level rejections never count as admitted, so the four
+        settle paths (completed / expired / failed / cancelled) are
+        exhaustive."""
+        with self._lock:
+            return self.admitted - (self.completed + self.rejected_deadline
+                                    + self.failed + self.cancelled)
+
     def record_batch(self, n_real: int, bucket: int):
         """One executed batch: `n_real` genuine requests padded to `bucket`."""
         self.batch_size.observe(n_real)
@@ -100,6 +116,7 @@ class ServeMetrics:
                 "rejected_deadline": self.rejected_deadline,
                 "rejected_shutdown": self.rejected_shutdown,
                 "failed": self.failed,
+                "cancelled": self.cancelled,
                 "n_batches": int(sizes["count"]),
             }
         out.update(pct)
@@ -120,7 +137,8 @@ class ServeMetrics:
             if not math.isnan(v):
                 vals[f"serve/latency_{tag}"] = v
         for tag in ("admitted", "completed", "rejected_queue_full",
-                    "rejected_deadline", "rejected_shutdown", "failed"):
+                    "rejected_deadline", "rejected_shutdown", "failed",
+                    "cancelled"):
             vals[f"serve/{tag}"] = snap[tag]
         vals["serve/mean_batch_size"] = snap["mean_batch_size"]
         vals["serve/mean_occupancy"] = snap["mean_occupancy"]

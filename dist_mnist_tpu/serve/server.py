@@ -106,16 +106,40 @@ class InferenceServer:
         return False
 
     # -- serving -------------------------------------------------------------
-    def submit(self, image, *, deadline_ms: float | None = None):
+    def submit(self, image, *, deadline_ms: float | None = None,
+               cancel_event=None):
         """One request -> Future[InferenceResult]. Never blocks."""
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
-        return self._admission.submit(image, deadline_ms=deadline_ms)
+        return self._admission.submit(image, deadline_ms=deadline_ms,
+                                      cancel_event=cancel_event)
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Wait (bounded) until every ADMITTED request has settled, without
+        closing anything — the hot-swap drain step (serve/router.py's
+        drain->swap->rewarm) needs an empty pipeline while the server stays
+        open for the traffic that resumes after the swap. The caller must
+        stop submitting first (the router stops routing to a `swapping`
+        replica); otherwise new admissions keep the pipeline non-idle and
+        this simply times out. Returns True when idle."""
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        while _t.monotonic() < deadline:
+            if self.metrics.inflight == 0 and self.queue_depth == 0:
+                return True
+            _t.sleep(0.002)
+        return False
 
     # -- observability -------------------------------------------------------
     @property
     def queue_depth(self) -> int:
         return self._admission.depth
+
+    @property
+    def capacity(self) -> int:
+        """Admission bound — the denominator of a router's backlog fraction."""
+        return self._admission.maxsize
 
     def stats(self) -> dict:
         out = self.metrics.snapshot()

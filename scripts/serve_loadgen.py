@@ -9,6 +9,14 @@ throughput knee is one script run.
 
     python scripts/serve_loadgen.py --config mlp_mnist --requests 512 \
         --concurrency 1,8,64 --platform cpu --host-device-count 8
+
+``--fleet N`` switches to the two-class fleet generator
+(`run_fleet_loadgen`) against an in-process N-replica `serve/router.py`
+Router sharing one compile cache — per-class latency/shed/reject
+accounting at each concurrency level:
+
+    python scripts/serve_loadgen.py --fleet 3 --ls-fraction 0.8 \
+        --ls-deadline-ms 500 --platform cpu --host-device-count 8
 """
 
 from __future__ import annotations
@@ -35,6 +43,13 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--platform", default=None)
     ap.add_argument("--host-device-count", type=int, default=None)
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="drive an in-process N-replica router with "
+                         "two-class traffic instead of one server")
+    ap.add_argument("--ls-fraction", type=float, default=0.8,
+                    help="latency_sensitive fraction in --fleet mode")
+    ap.add_argument("--ls-deadline-ms", type=float, default=None)
+    ap.add_argument("--be-deadline-ms", type=float, default=None)
     args = ap.parse_args()
 
     from dist_mnist_tpu.cluster import initialize_distributed
@@ -57,6 +72,8 @@ def main() -> int:
     cfg = get_config(args.config)
     mesh = make_mesh(cfg.mesh)
     bundle = load_for_serving(cfg, mesh, checkpoint_dir=args.checkpoint_dir)
+    if args.fleet:
+        return _fleet_sweep(args, cfg, mesh, bundle)
     engine = InferenceEngine(
         bundle.model, bundle.params, bundle.model_state, mesh,
         model_name=cfg.model, image_shape=bundle.image_shape,
@@ -78,6 +95,56 @@ def main() -> int:
                 image_shape=bundle.image_shape,
                 seed=args.seed,
             )
+        print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+def _fleet_sweep(args, cfg, mesh, bundle) -> int:
+    """Fleet mode: fresh N-replica router per concurrency level, one
+    shared compile cache across every replica and level."""
+    from dist_mnist_tpu.obs import HealthState
+    from dist_mnist_tpu.serve import (
+        CompiledModelCache,
+        InferenceEngine,
+        InferenceServer,
+        InProcessReplica,
+        Router,
+        ServeConfig,
+        run_fleet_loadgen,
+    )
+
+    shared_cache = CompiledModelCache()
+
+    def make_server():
+        engine = InferenceEngine(
+            bundle.model, bundle.params, bundle.model_state, mesh,
+            model_name=cfg.model, image_shape=bundle.image_shape,
+            rules=bundle.rules, max_bucket=args.max_batch,
+            cache=shared_cache,
+        )
+        return InferenceServer(engine, ServeConfig(
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            queue_depth=args.queue_depth), health=HealthState()).start()
+
+    for conc in (int(c) for c in args.concurrency.split(",")):
+        fleet = [InProcessReplica(i, make_server).start()
+                 for i in range(args.fleet)]
+        router = Router(fleet).start()
+        try:
+            summary = run_fleet_loadgen(
+                router,
+                n_requests=args.requests,
+                concurrency=conc,
+                image_shape=bundle.image_shape,
+                seed=args.seed,
+                ls_fraction=args.ls_fraction,
+                ls_deadline_ms=args.ls_deadline_ms,
+                be_deadline_ms=args.be_deadline_ms,
+            )
+        finally:
+            router.close()
+            for r in fleet:
+                r.close()
         print(json.dumps(summary, sort_keys=True))
     return 0
 

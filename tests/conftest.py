@@ -69,7 +69,11 @@ def _no_leaked_prefetch_workers():
     HTTP threads/sockets
     (``ObsExporter*`` serve threads and obs/exporter.py's
     ``_LIVE_EXPORTERS`` — an unclosed exporter holds a bound port for the
-    rest of the session), and warm-start/coldstart/journal temp dirs
+    rest of the session), fleet-router threads/registries (``Router*`` —
+    RouterHealth/RouterTimer/RouterWatcher/RouterHttp pools,
+    serve/router.py's ``_LIVE_ROUTERS``, and cli/router.py's
+    ``_LIVE_REPLICA_PROCS`` subprocess replicas), and
+    warm-start/coldstart/journal temp dirs
     created OUTSIDE pytest's tmp root (launch()'s supervisor mkdtemp and
     bench.py's coldstart pair dir must clean up after themselves). Polls
     briefly: a worker that JUST saw its stop flag may still be mid-exit
@@ -97,11 +101,21 @@ def _no_leaked_prefetch_workers():
                        or t.name.startswith("Elastic")
                        or t.name.startswith("CompileCache")
                        or t.name.startswith("SnapshotWriter")
-                       or t.name.startswith("ObsExporter"))]
+                       or t.name.startswith("ObsExporter")
+                       or t.name.startswith("Router"))]
         exporter_mod = sys.modules.get("dist_mnist_tpu.obs.exporter")
         if exporter_mod is not None:
             leaked += [f"open exporter port={e.port}"
                        for e in exporter_mod._LIVE_EXPORTERS]
+        router_mod = sys.modules.get("dist_mnist_tpu.serve.router")
+        if router_mod is not None:
+            leaked += [f"open router ({len(router_mod._LIVE_ROUTERS)})"
+                       for _ in router_mod._LIVE_ROUTERS]
+        cli_router_mod = sys.modules.get("dist_mnist_tpu.cli.router")
+        if cli_router_mod is not None:
+            leaked += [f"replica pid={p.pid}"
+                       for p in cli_router_mod._LIVE_REPLICA_PROCS
+                       if p.poll() is None]
         launch_mod = sys.modules.get("dist_mnist_tpu.cli.launch")
         if launch_mod is not None:
             leaked += [f"child pid={p.pid}" for p in launch_mod._LIVE_CHILDREN
