@@ -556,6 +556,78 @@ def bench_serve(n_requests: int, concurrency: int) -> int:
     return 0
 
 
+def bench_serve_longctx(n_requests: int, concurrency: int) -> int:
+    """Long-context (variable-length) serving through the model-zoo grid
+    (serve/zoo.py): a maskable ViT behind the auto power-of-two height
+    ladder, driven with seeded variable-height traffic. Reports the p99
+    over ALL heights plus the zoo's load-bearing counters: per-device
+    resident weight bytes (the sharded-serving number), per-seq-bucket
+    request routing, and the compile-cache miss delta during traffic —
+    which must be ZERO after prewarm (the no-hot-path-recompile
+    guarantee the 2-D grid exists to give)."""
+    import jax
+
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.serve import (
+        InferenceServer,
+        ServeConfig,
+        build_zoo_engine,
+        load_for_serving,
+        run_longctx_loadgen,
+    )
+
+    metric = "longctx_p99_ms"
+    mesh = make_mesh(MeshSpec(data=-1))
+    bundle = load_for_serving("vit_tiny_cifar", mesh)
+    # max_batch 32 bounds the grid: 3 batch buckets x (1 dense + masked
+    # ladder) executables, all compiled up front by prewarm
+    engine = build_zoo_engine(
+        bundle, mesh, model_name="vit_tiny", max_bucket=32,
+        seq_buckets="auto",
+    )
+    server = InferenceServer(engine, ServeConfig(
+        max_batch=32, max_wait_ms=2.0, queue_depth=4 * concurrency,
+    ))
+    with server:
+        # warmup traffic AFTER prewarm: first-dispatch cost off the timed
+        # run (prewarm already took every compile off it)
+        run_longctx_loadgen(server, n_requests=concurrency,
+                            concurrency=concurrency, seed=1)
+        summary = run_longctx_loadgen(server, n_requests=n_requests,
+                                      concurrency=concurrency, seed=0)
+    if summary["recompiles_during_traffic"]:
+        emit_error(metric,
+                   f"{summary['recompiles_during_traffic']} hot-path "
+                   "recompile(s) after a full grid prewarm")
+        return 1
+    state_bytes = engine.state_bytes_per_device()
+    emit({
+        "metric": metric,
+        "value": round(summary["p99_ms"], 2),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "extra": {
+            "chips": jax.device_count(),
+            "p50_ms": round(summary["p50_ms"], 2),
+            "p95_ms": round(summary["p95_ms"], 2),
+            "mean_ms": round(summary["mean_ms"], 2),
+            "n_requests": n_requests,
+            "concurrency": concurrency,
+            "ok": summary["ok"],
+            "seq_buckets": list(engine.seq_grid.heights),
+            "seq_bucket_counts": summary["seq_bucket_counts"],
+            "recompiles_during_traffic":
+                summary["recompiles_during_traffic"],
+            "serve_state_bytes_per_device": state_bytes,
+            "cache": summary["cache"],
+            "mean_seq_occupancy": round(summary["mean_seq_occupancy"], 3),
+            "mean_batch_size": round(summary["mean_batch_size"], 2),
+            **_anchor_fields(metric, summary["p99_ms"]),
+        },
+    })
+    return 0
+
+
 def bench_serve_fleet(n_requests: int, concurrency: int, *,
                       replicas: int = 3) -> int:
     """Fleet-serving robustness: two-class traffic through a 3-replica
@@ -2124,6 +2196,13 @@ if __name__ == "__main__":
                          "(fleet_p99_latency_sensitive_ms)")
     ap.add_argument("--fleet-replicas", type=int, default=3,
                     help="fleet size in --serve --fleet mode")
+    ap.add_argument("--longctx", action="store_true",
+                    help="with --serve: long-context mode — variable-height "
+                         "traffic through the model-zoo (batch, seq-bucket) "
+                         "grid on a maskable ViT; asserts zero hot-path "
+                         "recompiles after prewarm and reports p99 over all "
+                         "heights plus per-device resident bytes "
+                         "(longctx_p99_ms)")
     ap.add_argument("--input", action="store_true", dest="input_mode",
                     help="input-stall attribution mode: time sync-feed vs "
                          "device-prefetched feed on the same model/stream "
@@ -2190,6 +2269,7 @@ if __name__ == "__main__":
         sys.exit(coldstart_child(args.coldstart_child, args.coldstart_steps))
     metric = ("fleet_p99_latency_sensitive_ms"
               if args.serve and args.fleet
+              else "longctx_p99_ms" if args.serve and args.longctx
               else "serve_p99_latency_ms" if args.serve
               else "input_stall_ms_per_step" if args.input_mode
               else "fsdp_per_device_state_bytes" if args.memory_mode
@@ -2219,6 +2299,8 @@ if __name__ == "__main__":
         sys.exit(bench_serve_fleet(args.requests, args.concurrency,
                                    replicas=args.fleet_replicas)
                  if args.serve and args.fleet
+                 else bench_serve_longctx(args.requests, args.concurrency)
+                 if args.serve and args.longctx
                  else bench_serve(args.requests, args.concurrency)
                  if args.serve
                  else bench_input(args.steps, depth=args.prefetch_depth)

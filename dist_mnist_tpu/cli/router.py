@@ -55,6 +55,19 @@ flags.DEFINE_integer("queue_depth", 256, "per-replica admission bound")
 flags.DEFINE_string("compile_cache_dir", None,
                     "compilecache/ directory shared by the fleet; restarts "
                     "and subprocess replicas rewarm from its disk tier")
+# -- model-zoo serving (serve/zoo.py; forwarded to every replica) -------------
+flags.DEFINE_string("seq_buckets", None,
+                    'variable-length serving: "auto", "h1,h2,...", or unset '
+                    "for the native-only engine (see cli/serve.py)")
+flags.DEFINE_float("moe_capacity_factor", 0,
+                   "inference-time MoE expert capacity factor override; "
+                   "0 = the checkpoint's train-time factor")
+flags.DEFINE_float("serve_memory_budget_mb", 0,
+                   "per-device weights+executables budget (MiB) per "
+                   "replica engine; 0 = unbounded")
+flags.DEFINE_string("serve_rules", None,
+                    "serve-time sharding strategy override (cross-strategy "
+                    "restore; see docs/SERVING.md)")
 flags.DEFINE_string("fault_plan", None,
                     "faults/plan.py FaultPlan JSON (inline or path); "
                     "serve_replica_kill / serve_replica_stall target "
@@ -132,6 +145,15 @@ def _spawn_replicas(n: int):
             cmd.append(f"--host_device_count={FLAGS.host_device_count}")
         if FLAGS.compile_cache_dir:
             cmd.append(f"--compile_cache_dir={FLAGS.compile_cache_dir}")
+        if FLAGS.seq_buckets:
+            cmd.append(f"--seq_buckets={FLAGS.seq_buckets}")
+        if FLAGS.moe_capacity_factor:
+            cmd.append(f"--moe_capacity_factor={FLAGS.moe_capacity_factor}")
+        if FLAGS.serve_memory_budget_mb:
+            cmd.append(
+                f"--serve_memory_budget_mb={FLAGS.serve_memory_budget_mb}")
+        if FLAGS.serve_rules:
+            cmd.append(f"--serve_rules={FLAGS.serve_rules}")
         if FLAGS.fault_plan:
             cmd.append(f"--fault_plan={FLAGS.fault_plan}")
         if FLAGS.mesh:
@@ -175,10 +197,10 @@ def _build_inprocess_replicas(n: int):
     from dist_mnist_tpu.obs import HealthState
     from dist_mnist_tpu.serve import (
         CompiledModelCache,
-        InferenceEngine,
         InferenceServer,
         InProcessReplica,
         ServeConfig,
+        build_zoo_engine,
         load_for_serving,
     )
 
@@ -193,7 +215,8 @@ def _build_inprocess_replicas(n: int):
         spec = MeshSpec(**{k: int(v) for k, v in kv.items()})
     mesh = make_mesh(spec)
     bundle = load_for_serving(
-        cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step)
+        cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step,
+        sharding_rules=FLAGS.serve_rules)
     store = None
     if FLAGS.compile_cache_dir:
         from pathlib import Path
@@ -210,10 +233,12 @@ def _build_inprocess_replicas(n: int):
 
     def make_server_factory(replica_id: int):
         def make_server():
-            engine = InferenceEngine(
-                bundle.model, bundle.params, bundle.model_state, mesh,
-                model_name=cfg.model, image_shape=bundle.image_shape,
-                rules=bundle.rules, max_bucket=max(FLAGS.max_batch, 1),
+            engine = build_zoo_engine(
+                bundle, mesh, model_name=cfg.model,
+                max_bucket=max(FLAGS.max_batch, 1),
+                seq_buckets=FLAGS.seq_buckets or None,
+                moe_capacity_factor=FLAGS.moe_capacity_factor or None,
+                memory_budget_mb=FLAGS.serve_memory_budget_mb or None,
                 cache=shared_cache,
             )
             if plan is not None:
@@ -230,7 +255,8 @@ def _build_inprocess_replicas(n: int):
 
     def load_weights(step: int):
         new = load_for_serving(
-            cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=step)
+            cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=step,
+            sharding_rules=FLAGS.serve_rules)
         if not new.restored:
             raise FileNotFoundError(f"no committed checkpoint at step {step}")
         return new.params, new.model_state

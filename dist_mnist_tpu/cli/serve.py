@@ -46,6 +46,33 @@ flags.DEFINE_float("max_wait_ms", 2.0, "coalesce window after first request")
 flags.DEFINE_integer("queue_depth", 256, "admission queue bound")
 flags.DEFINE_float("deadline_ms", 0, "per-request deadline; 0 = none")
 flags.DEFINE_boolean("prewarm", True, "compile all buckets before serving")
+flags.DEFINE_boolean("prewarm_async", False,
+                     "warm the compile grid on a background ZooPrewarm "
+                     "thread while already serving (first requests may pay "
+                     "an on-demand compile; startup stays flat as the zoo "
+                     "grid grows)")
+# -- model-zoo serving (serve/zoo.py) ----------------------------------------
+flags.DEFINE_string("seq_buckets", None,
+                    'variable-length serving: "auto" for the power-of-two '
+                    'height ladder, "h1,h2,..." for explicit bucket '
+                    "ceilings (native appended), unset for the native-only "
+                    "engine. Sub-native requests are right-padded and "
+                    "masked; the native bucket keeps the maskless "
+                    "bit-parity program")
+flags.DEFINE_float("moe_capacity_factor", 0,
+                   "inference-time MoE expert capacity factor override; "
+                   "0 = the checkpoint's train-time factor. Overflow drops "
+                   "surface as serve/moe_drop_fraction, never silently")
+flags.DEFINE_float("serve_memory_budget_mb", 0,
+                   "per-device budget (MiB) for weights + compiled "
+                   "executables: prewarm REFUSES a grid that cannot fit; "
+                   "live traffic evicts coldest grid cells LRU. 0 = "
+                   "unbounded")
+flags.DEFINE_string("serve_rules", None,
+                    "serve-time sharding strategy override (none/dp/tp/"
+                    "fsdp/fsdp_tp): restore a checkpoint trained under one "
+                    "strategy directly into another's layout (cross-"
+                    "strategy restore; see docs/SERVING.md)")
 flags.DEFINE_string("compile_cache_dir", None,
                     "warm-start cache directory (compilecache/): prewarm "
                     "deserializes the buckets a previous server process "
@@ -106,7 +133,8 @@ def _serve_forever(server, exporter, cfg, mesh) -> dict:
             if not server.quiesce(timeout=30.0):
                 raise TimeoutError("pipeline did not quiesce for swap")
             new = load_for_serving(
-                cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=step)
+                cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=step,
+                sharding_rules=FLAGS.serve_rules)
             if not new.restored:
                 raise FileNotFoundError(
                     f"no committed checkpoint at step {step}")
@@ -154,9 +182,9 @@ def main(argv):
     from dist_mnist_tpu.obs import events as events_mod
     from dist_mnist_tpu.obs.writers import make_default_writer
     from dist_mnist_tpu.serve import (
-        InferenceEngine,
         InferenceServer,
         ServeConfig,
+        build_zoo_engine,
         load_for_serving,
         run_loadgen,
     )
@@ -202,7 +230,8 @@ def main(argv):
     mesh = make_mesh(spec)
 
     bundle = load_for_serving(
-        cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step
+        cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step,
+        sharding_rules=FLAGS.serve_rules,
     )
     store = None
     if FLAGS.compile_cache_dir:
@@ -216,12 +245,15 @@ def main(argv):
         cache_root = Path(FLAGS.compile_cache_dir)
         enable_persistent_cache(cache_root / "xla")
         store = ExecutableStore(cache_root / "exe")
-    engine = InferenceEngine(
-        bundle.model, bundle.params, bundle.model_state, mesh,
-        model_name=cfg.model, image_shape=bundle.image_shape,
-        rules=bundle.rules, max_bucket=max(FLAGS.max_batch, 1),
+    engine = build_zoo_engine(
+        bundle, mesh, model_name=cfg.model,
+        max_bucket=max(FLAGS.max_batch, 1),
+        seq_buckets=FLAGS.seq_buckets or None,
+        moe_capacity_factor=FLAGS.moe_capacity_factor or None,
+        memory_budget_mb=FLAGS.serve_memory_budget_mb or None,
         store=store,
     )
+    zoo_engine = engine  # pre-wrap handle for the zoo summary fields
     if FLAGS.fault_plan:
         from dist_mnist_tpu.faults import FaultPlan
 
@@ -239,6 +271,7 @@ def main(argv):
             queue_depth=FLAGS.queue_depth,
             default_deadline_ms=FLAGS.deadline_ms or None,
             prewarm=FLAGS.prewarm,
+            prewarm_async=FLAGS.prewarm_async,
         ),
         writer=writer,
         health=health,
@@ -265,6 +298,13 @@ def main(argv):
             journal.close()
     summary["checkpoint_step"] = bundle.step
     summary["restored"] = bundle.restored
+    summary["serve_state_bytes_per_device"] = \
+        zoo_engine.state_bytes_per_device()
+    if zoo_engine.seq_grid is not None:
+        summary["seq_buckets"] = list(zoo_engine.seq_grid.heights)
+        summary["seq_bucket_counts"] = {
+            str(k): v for k, v in sorted(zoo_engine.seq_bucket_counts.items())
+        }
     if store is not None:
         summary["compile_cache"] = store.stats()
     print(json.dumps(summary, indent=2, sort_keys=True))

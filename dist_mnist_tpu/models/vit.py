@@ -182,9 +182,16 @@ class ViTTiny:
         )
         return params, state
 
-    def _attention(self, p, x):
+    def _attention(self, p, x, mask=None):
         if self.attention_impl == "xla":
-            return nn.multi_head_attention(p, x, self.heads)
+            return nn.multi_head_attention(p, x, self.heads, mask=mask)
+        if mask is not None:
+            # the kernel impls (flash/ring/ulysses) take no mask argument;
+            # serve/zoo.py degrades them to the native-length-only bucket
+            raise ValueError(
+                f"attention_impl {self.attention_impl!r} does not support a "
+                "token mask; serve at native length or use 'xla'"
+            )
         b, s, d = x.shape
         h = self.heads
         qkv = nn.dense(p["qkv"], x).reshape(b, s, 3, h, d // h)
@@ -238,10 +245,10 @@ class ViTTiny:
                 "expert_load": jnp.zeros((self.n_experts,)),
                 "ep_engaged": jnp.zeros(())}
 
-    def _block(self, p, x, layer_rng, use_dropout):
+    def _block(self, p, x, layer_rng, use_dropout, mask=None):
         """One pre-LN transformer block; returns (x, moe_aux, moe_stats)."""
         y = nn.layer_norm(p["ln1"], x)
-        x = x + self._attention(p["attn"], y)
+        x = x + self._attention(p["attn"], y, mask=mask)
         y = nn.layer_norm(p["ln2"], x)
         aux = jnp.zeros((), jnp.float32)
         stats = self._moe_zero_stats() if self.mlp_impl == "moe" else None
@@ -359,15 +366,42 @@ class ViTTiny:
                               rng=rng if use_dropout else None,
                               skip_bubble=self.pipeline_skip_bubble)
 
-    def apply(self, params, state, x, *, train=False, rng=None):
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        """`mask` [B, patch_tokens] marks real patch tokens for inputs whose
+        HEIGHT was right-padded below the init-time native shape (variable-
+        length serving, serve/zoo.py): padded keys are masked out of every
+        attention softmax and out of the pool, and `pos` is sliced to the
+        actual token count — so a short input's logits equal running it at
+        its own native bucket. `mask=None` (every training/eval call)
+        compiles the exact historical program. Requires attention_impl
+        "xla" and no block pipeline; MoE note: padded tokens still occupy
+        router capacity slots (shape-stable executables), which shows up in
+        `moe_drop_fraction_metric` rather than corrupting real tokens."""
         x = x.astype(self.compute_dtype)
         x = nn.conv2d(params["patch"], x, stride=self.patch, padding="VALID")
         b, ph, pw, d = x.shape
         x = x.reshape(b, ph * pw, d)
+        tok_mask = None
+        if mask is not None:
+            if mask.shape != (b, ph * pw):
+                raise ValueError(
+                    f"mask shape {mask.shape} != (batch, patch_tokens) "
+                    f"{(b, ph * pw)}"
+                )
+            tok_mask = mask.astype(bool)
         if self.pool == "cls":
             cls = jnp.broadcast_to(params["cls"].astype(x.dtype), (b, 1, d))
             x = jnp.concatenate([cls, x], axis=1)
-        x = x + params["pos"].astype(x.dtype)
+            if tok_mask is not None:  # the CLS token is always real
+                tok_mask = jnp.concatenate(
+                    [jnp.ones((b, 1), bool), tok_mask], axis=1)
+        # slice, not broadcast: a sub-native token count (masked serving)
+        # uses the leading rows of the learned table — row-major patch
+        # order means the first k*pw entries ARE the top k patch-rows'
+        # positions. At native length the slice is the whole table.
+        x = x + params["pos"][:, : x.shape[1]].astype(x.dtype)
+        if tok_mask is not None and self.block_pipeline:
+            raise ValueError("mask is not supported with block_pipeline")
         use_dropout = train and rng is not None and self.dropout_rate > 0
         rngs = (jax.random.split(rng, self.depth) if use_dropout
                 else jnp.zeros((self.depth,)))  # scannable dummy
@@ -381,7 +415,8 @@ class ViTTiny:
             def body(carry, xs):
                 x, aux_total, stats_total = carry
                 p, layer_rng = xs
-                x, aux, stats = self._block(p, x, layer_rng, use_dropout)
+                x, aux, stats = self._block(p, x, layer_rng, use_dropout,
+                                            mask=tok_mask)
                 if is_moe:
                     stats_total = jax.tree.map(jnp.add, stats_total, stats)
                 return (x, aux_total + aux, stats_total), None
@@ -394,12 +429,18 @@ class ViTTiny:
             aux_total, stats_total = zero_aux, zero_stats
             for i in range(self.depth):
                 x, aux, stats = self._block(params[f"block{i}"], x, rngs[i],
-                                            use_dropout)
+                                            use_dropout, mask=tok_mask)
                 aux_total = aux_total + aux
                 if is_moe:
                     stats_total = jax.tree.map(jnp.add, stats_total, stats)
         x = nn.layer_norm(params["final_ln"], x)
-        pooled = x[:, 0] if self.pool == "cls" else jnp.mean(x, axis=1)
+        if self.pool == "cls":
+            pooled = x[:, 0]
+        elif tok_mask is None:
+            pooled = jnp.mean(x, axis=1)
+        else:  # masked mean: padded rows carry garbage, weight them 0
+            m = tok_mask.astype(x.dtype)[..., None]
+            pooled = jnp.sum(x * m, axis=1) / jnp.sum(m, axis=1)
         logits = nn.dense(params["head"], pooled)
         if is_moe:
             # stats are depth-means; `_metric` keys surface as step outputs

@@ -200,20 +200,29 @@ def init_attention(key, dim: int, num_heads: int) -> Params:
     }
 
 
-def multi_head_attention(p: Params, x: jax.Array, num_heads: int) -> jax.Array:
+def multi_head_attention(p: Params, x: jax.Array, num_heads: int,
+                         mask: jax.Array | None = None) -> jax.Array:
     """[B, S, D] self-attention. Kept simple/fused-friendly; the Pallas flash
     kernel (ops/pallas) and ring attention (parallel/ring_attention.py) are
-    drop-in replacements for the inner softmax(QK^T)V."""
+    drop-in replacements for the inner softmax(QK^T)V. `mask` [B, S] marks
+    real tokens (serve-side right-padding, serve/zoo.py); None compiles the
+    exact historical maskless program."""
     b, s, d = x.shape
     h = num_heads
     qkv = dense(p["qkv"], x).reshape(b, s, 3, h, d // h)
     q, k, v = jnp.moveaxis(qkv, 2, 0)  # each [B, S, H, Dh]
-    out = dot_product_attention(q, k, v)
+    out = dot_product_attention(q, k, v, mask=mask)
     return dense(p["out"], out.reshape(b, s, d))
 
 
-def dot_product_attention(q, k, v) -> jax.Array:
+def dot_product_attention(q, k, v, mask: jax.Array | None = None) -> jax.Array:
     """[B, S, H, Dh] -> [B, S, H, Dh]; accumulation in f32 for stability.
+
+    `mask` [B, S_k] marks REAL keys: padded keys get -inf scores before the
+    softmax, so no query (real or padded) attends to padding — padded
+    QUERIES still produce garbage rows, which the caller must exclude from
+    pooling/loss (ViT's masked pooling does). With mask=None the program is
+    bit-identical to the historical maskless one.
 
     The result is tagged `checkpoint_name("attn_out")` so the `save_attn`
     remat policy (train/step.py REMAT_POLICIES) can keep it in HBM instead
@@ -223,6 +232,11 @@ def dot_product_attention(q, k, v) -> jax.Array:
 
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        # [B, S_k] -> [B, 1, 1, S_k]; finite large-negative (not -inf) so a
+        # fully-masked row still softmaxes to a uniform finite result
+        logits = jnp.where(mask[:, None, None, :].astype(bool), logits,
+                           jnp.float32(-1e30))
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return checkpoint_name(
         jnp.einsum("bhqk,bkhd->bqhd", weights, v), "attn_out"

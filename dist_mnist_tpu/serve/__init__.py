@@ -14,6 +14,8 @@ Layering (each module depends only on those above it):
     admission.py  bounded queue, deadlines, explicit rejection
     batcher.py    the coalescing loop (one daemon thread)
     loader.py     checkpoint -> (model, params, model_state), no optimizer
+    zoo.py        model-zoo planning: sequence grids, capacity overrides,
+                  maskability probes, per-device byte accounting
     server.py     InferenceServer facade wiring all of the above
     errors.py     failure taxonomy: retryable / terminal / replica-fatal
     router.py     fleet facade: N replicas, tiered shedding, failover,
@@ -27,7 +29,11 @@ from dist_mnist_tpu.serve.admission import (
     QueueFullError,
     ShuttingDownError,
 )
-from dist_mnist_tpu.serve.engine import CompiledModelCache, InferenceEngine
+from dist_mnist_tpu.serve.engine import (
+    CompiledModelCache,
+    InferenceEngine,
+    ServeMemoryBudgetError,
+)
 from dist_mnist_tpu.serve.errors import (
     AllReplicasDownError,
     ReplicaKilledError,
@@ -35,7 +41,11 @@ from dist_mnist_tpu.serve.errors import (
     classify_failure,
 )
 from dist_mnist_tpu.serve.loader import load_for_serving
-from dist_mnist_tpu.serve.loadgen import run_fleet_loadgen, run_loadgen
+from dist_mnist_tpu.serve.loadgen import (
+    run_fleet_loadgen,
+    run_loadgen,
+    run_longctx_loadgen,
+)
 from dist_mnist_tpu.serve.metrics import ServeMetrics
 from dist_mnist_tpu.serve.router import (
     BEST_EFFORT,
@@ -47,6 +57,13 @@ from dist_mnist_tpu.serve.router import (
     RouterConfig,
 )
 from dist_mnist_tpu.serve.server import InferenceServer, ServeConfig
+from dist_mnist_tpu.serve.zoo import (
+    SeqGrid,
+    build_zoo_engine,
+    default_seq_grid,
+    parse_seq_buckets,
+    supports_mask,
+)
 
 __all__ = [
     "AdmissionQueue",
@@ -64,12 +81,19 @@ __all__ = [
     "ReplicaKilledError",
     "Router",
     "RouterConfig",
+    "SeqGrid",
     "ServeConfig",
+    "ServeMemoryBudgetError",
     "ServeMetrics",
     "ShedError",
     "ShuttingDownError",
+    "build_zoo_engine",
     "classify_failure",
+    "default_seq_grid",
     "load_for_serving",
+    "parse_seq_buckets",
     "run_fleet_loadgen",
     "run_loadgen",
+    "run_longctx_loadgen",
+    "supports_mask",
 ]

@@ -43,10 +43,10 @@ _IDLE_POLL_SECS = 0.05
 class DynamicBatcher:
     def __init__(self, engine, admission: AdmissionQueue, metrics, *,
                  max_batch: int = 64, max_wait_ms: float = 2.0):
-        if max_batch > engine.max_bucket:
-            raise ValueError(
-                f"max_batch {max_batch} > engine max_bucket {engine.max_bucket}"
-            )
+        # max_batch MAY exceed the engine's max_bucket: an oversized
+        # coalesce window is split into max_bucket-sized engine batches at
+        # execution (engine.bucket_for's raise remains for DIRECT predict
+        # calls that exceed the ceiling in one go)
         self.engine = engine
         self.admission = admission
         self.metrics = metrics
@@ -103,22 +103,51 @@ class DynamicBatcher:
                 live.append(req)
         if not live:
             return
+        # variable-length serving: one engine batch per image shape (the
+        # engine pads each group to its own (batch, height) grid cell —
+        # stacking mixed heights is impossible anyway), preserving
+        # submission order within a group. An oversized window — max_batch
+        # beyond the engine's bucket ceiling — is split here into
+        # max_bucket-sized executions instead of bucket_for raising.
+        groups: dict[tuple, list[Request]] = {}
+        for req in live:
+            groups.setdefault(tuple(req.image.shape), []).append(req)
+        for reqs in groups.values():
+            for i in range(0, len(reqs), self.engine.max_bucket):
+                self._execute(reqs[i:i + self.engine.max_bucket])
+
+    def _execute(self, reqs: list[Request]) -> None:
+        """One engine call for same-shaped `reqs` (<= max_bucket of them)."""
         try:
-            images = np.stack([r.image for r in live])
+            images = np.stack([r.image for r in reqs])
             logits = self.engine.predict(images)
         except Exception as err:  # fail the batch, keep the server
-            log.exception("batch of %d failed", len(live))
-            self.metrics.record_failed(len(live))
-            for req in live:
+            log.exception("batch of %d failed", len(reqs))
+            self.metrics.record_failed(len(reqs))
+            for req in reqs:
                 req.future.set_exception(err)
             return
         done = time.monotonic()
-        self.metrics.record_batch(len(live), self.engine.bucket_for(len(live)))
-        for req, row in zip(live, logits):
+        self.metrics.record_batch(
+            len(reqs), self.engine.bucket_for(len(reqs)),
+            seq_occupancy=self._seq_occupancy(images),
+            moe_drop_fraction=getattr(
+                self.engine, "last_moe_drop_fraction", None))
+        for req, row in zip(reqs, logits):
             latency_ms = (done - req.t_submit) * 1e3
             self.metrics.record_latency(latency_ms)
             req.future.set_result(InferenceResult(
                 logits=row, label=int(row.argmax()), latency_ms=latency_ms))
+
+    def _seq_occupancy(self, images) -> float | None:
+        """Real tokens / padded tokens for one executed group, None for a
+        native-only engine (no sequence padding to attribute)."""
+        grid = getattr(self.engine, "seq_grid", None)
+        if grid is None:
+            return None
+        h = images.shape[1]
+        bucket_h = self.engine.seq_bucket_for(h)
+        return grid.n_tokens(h) / grid.n_tokens(bucket_h)
 
     def _loop(self) -> None:
         while True:

@@ -39,18 +39,50 @@ from dist_mnist_tpu.utils.timing import stopclock
 log = logging.getLogger(__name__)
 
 
+class ServeMemoryBudgetError(RuntimeError):
+    """The serve-side memory budget cannot hold the requested working set:
+    either the weights alone exceed it, or the prewarm grid's executables
+    would evict each other (thrash) instead of all staying resident."""
+
+
+def _exe_nbytes(exe) -> int:
+    """Best-effort per-device byte attribution for an AOT executable:
+    XLA's own memory analysis (generated code + temp allocations — the
+    bytes the program itself pins beyond its arguments), 0 when the
+    backend doesn't expose one (budget accounting then covers weights +
+    counted-as-zero executables, still monotonic in grid size)."""
+    try:
+        m = exe.memory_analysis()
+        return int(
+            getattr(m, "generated_code_size_in_bytes", 0)
+            + getattr(m, "temp_size_in_bytes", 0)
+        )
+    except Exception:  # noqa: BLE001 — backend-optional API
+        return 0
+
+
 class CompiledModelCache:
     """key -> AOT-compiled executable, with hit/miss counters, per-key
-    compile/load attribution, and an optional DISK tier. Keys are
-    `(model_name, input_shape, mesh_key, dtype)` — everything that changes
-    the compiled program.
+    compile/load attribution, an optional DISK tier, and an optional
+    MEMORY BUDGET. Keys are `(model_name, input_shape, mesh_key, dtype,
+    variant)` — everything that changes the compiled program (the variant
+    distinguishes the masked sub-native-sequence programs from the
+    maskless native one).
 
     With `store` (a compilecache.ExecutableStore), a memory miss consults
     the store before compiling and saves after: a restarted server's
     `prewarm()` deserializes last generation's executables in milliseconds
     instead of recompiling every bucket. Hits are tiered — `hits_memory`
     vs `hits_disk` — and `per_key` records, for each key, which tier
-    satisfied it first and the compile-or-load wall ms it cost."""
+    satisfied it first and the compile-or-load wall ms it cost.
+
+    With a budget (`set_budget`), every insert that pushes
+    `base_bytes` (served weights) + Σ executable bytes past the cap
+    evicts the COLDEST other entries (LRU by last touch) until it fits —
+    the hot path keeps serving while the least-loved bucket pays — and
+    raises `ServeMemoryBudgetError` when even an empty cache could not
+    hold the new entry. Evicted entries recompile (or disk-load) on next
+    use; `evictions` counts them."""
 
     def __init__(self, store=None):
         self._lock = threading.Lock()
@@ -60,9 +92,59 @@ class CompiledModelCache:
         self.misses = 0
         self.hits_memory = 0
         self.hits_disk = 0
-        #: key -> {"tier": memory|disk|fresh, "compile_ms", "load_ms", "hits"}
+        self.evictions = 0
+        self.budget_bytes: int | None = None
+        self.base_bytes = 0  # served weights, counted against the budget
+        self._tick = 0  # LRU clock: bumped on every touch
+        #: key -> {"tier": memory|disk|fresh, "compile_ms", "load_ms",
+        #:         "hits", "nbytes", "last_used"}
         self.per_key: dict = {}
         self.times: dict = {}  # stopclock accumulator: compile/execute secs
+
+    def set_budget(self, budget_bytes: int | None, *,
+                   base_bytes: int = 0) -> None:
+        """Arm (or disarm, None) the memory budget. `base_bytes` is the
+        non-evictable floor — the served weights' per-device bytes."""
+        with self._lock:
+            if budget_bytes is not None and base_bytes > budget_bytes:
+                raise ServeMemoryBudgetError(
+                    f"served weights alone ({base_bytes} B/device) exceed "
+                    f"the serve memory budget ({budget_bytes} B)")
+            self.budget_bytes = budget_bytes
+            self.base_bytes = base_bytes
+
+    def resident_bytes(self) -> int:
+        """base (weights) + every resident executable, per device."""
+        with self._lock:
+            return self.base_bytes + sum(
+                v.get("nbytes", 0) for k, v in self.per_key.items()
+                if k in self._cache)
+
+    def _admit_locked(self, key, nbytes: int) -> None:
+        """Evict coldest entries (never `key`) until the budget holds."""
+        if self.budget_bytes is None:
+            return
+        if self.base_bytes + nbytes > self.budget_bytes:
+            self._cache.pop(key, None)
+            raise ServeMemoryBudgetError(
+                f"executable for {key} ({nbytes} B) cannot fit the serve "
+                f"memory budget ({self.budget_bytes} B) even alone next to "
+                f"the weights ({self.base_bytes} B)")
+
+        def resident():
+            return self.base_bytes + sum(
+                v.get("nbytes", 0) for k, v in self.per_key.items()
+                if k in self._cache)
+
+        while resident() > self.budget_bytes:
+            victims = [k for k in self._cache if k != key]
+            victim = min(
+                victims, key=lambda k: self.per_key[k].get("last_used", 0))
+            del self._cache[victim]
+            self.evictions += 1
+            log.info("evicted %s (LRU) to hold the serve memory budget",
+                     victim)
+            events.emit("compile_cache", outcome="evict", key=str(victim))
 
     def get(self, key, build, *, store_key: str | None = None):
         """The executable for `key`: memory tier, then the disk store
@@ -70,10 +152,12 @@ class CompiledModelCache:
         runs under the lock: concurrent misses for the same bucket must
         not compile twice."""
         with self._lock:
+            self._tick += 1
             if key in self._cache:
                 self.hits += 1
                 self.hits_memory += 1
                 self.per_key[key]["hits"] += 1
+                self.per_key[key]["last_used"] = self._tick
                 return self._cache[key]
             if self._store is not None and store_key is not None:
                 t0 = _time.perf_counter()
@@ -83,8 +167,11 @@ class CompiledModelCache:
                     self.hits += 1
                     self.hits_disk += 1
                     self.per_key[key] = {"tier": "disk", "compile_ms": 0.0,
-                                         "load_ms": load_ms, "hits": 1}
+                                         "load_ms": load_ms, "hits": 1,
+                                         "nbytes": _exe_nbytes(exe),
+                                         "last_used": self._tick}
                     self._cache[key] = exe
+                    self._admit_locked(key, self.per_key[key]["nbytes"])
                     log.info("loaded %s from compile cache (%.0f ms)",
                              key, load_ms)
                     return exe
@@ -94,8 +181,11 @@ class CompiledModelCache:
                 exe = build()
                 compile_ms = (_time.perf_counter() - t0) * 1e3
             self.per_key[key] = {"tier": "fresh", "compile_ms": compile_ms,
-                                 "load_ms": 0.0, "hits": 0}
+                                 "load_ms": 0.0, "hits": 0,
+                                 "nbytes": _exe_nbytes(exe),
+                                 "last_used": self._tick}
             self._cache[key] = exe
+            self._admit_locked(key, self.per_key[key]["nbytes"])
             if self._store is not None and store_key is not None:
                 self._store.save(store_key, exe,
                                  meta={"compile_ms": compile_ms})
@@ -114,7 +204,12 @@ class CompiledModelCache:
                 "misses": self.misses,
                 "hits_memory": self.hits_memory,
                 "hits_disk": self.hits_disk,
+                "evictions": self.evictions,
                 "entries": len(self._cache),
+                "resident_bytes": self.base_bytes + sum(
+                    v.get("nbytes", 0) for k, v in self.per_key.items()
+                    if k in self._cache),
+                "budget_bytes": self.budget_bytes,
                 "compile_secs": self.times.get("compile", 0.0),
                 "execute_secs": self.times.get("execute", 0.0),
                 "execute_count": self.times.get("execute_count", 0),
@@ -149,6 +244,8 @@ class InferenceEngine:
         max_bucket: int = 256,
         store=None,
         cache: CompiledModelCache | None = None,
+        seq_grid=None,
+        memory_budget_bytes: int | None = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -161,6 +258,18 @@ class InferenceEngine:
         # A provided cache keeps ITS store; `store` only seeds a fresh one.
         self.cache = cache if cache is not None else CompiledModelCache(store=store)
         self._rules = rules
+        #: serve/zoo.SeqGrid (or None): the sequence-bucket axis of the
+        #: 2-D (batch, height) grid. None = the classic 1-D batch grid
+        #: pinned to the native image shape.
+        self.seq_grid = seq_grid
+        if seq_grid is not None and (
+                seq_grid.native_height != self.image_shape[0]
+                or (seq_grid.width, seq_grid.channels)
+                != tuple(self.image_shape[1:])):
+            raise ValueError(
+                f"seq_grid native shape ({seq_grid.native_height}, "
+                f"{seq_grid.width}, {seq_grid.channels}) != engine image "
+                f"shape {self.image_shape}")
         # buckets must divide over the data axis; the smallest power of two
         # >= the axis size always does (the axis size is itself a device
         # count, i.e. a power of two on every supported topology)
@@ -169,13 +278,63 @@ class InferenceEngine:
         # a ceiling below the data-axis floor would leave NO legal bucket
         self.max_bucket = max(max_bucket, self.min_bucket)
         self._batch_shd = NamedSharding(mesh, P(DATA_AXIS))
-        self._param_shd = tree_sharding(params, mesh, rules)
-        self._ms_shd = tree_sharding(model_state, mesh, rules)
+        # pin in_shardings off the LIVE weights when they already sit on
+        # THIS mesh (the make_eval_step idiom): a TP/fsdp restore placed by
+        # the loader serves resident-sharded; rule-derived placement is the
+        # fallback for host arrays / single-device trees, and `device_put`
+        # onto an array's own sharding is a no-op (no copy, no re-layout)
+        self._param_shd = self._live_or_rule_sharding(params, mesh, rules)
+        self._ms_shd = self._live_or_rule_sharding(model_state, mesh, rules)
         self.params = jax.device_put(params, self._param_shd)
         self.model_state = jax.device_put(model_state, self._ms_shd)
         #: version tag of the weights currently served (a train step after a
         #: hot swap; 0 for the construction-time weights)
         self.weights_version = 0
+        # MoE checkpoints surface routed-overflow drops as a serve metric:
+        # the compiled fwd returns `moe_drop_fraction_metric` beside the
+        # logits (never silent truncation); predict() stores the last
+        # batch's value here for the batcher to record.
+        self._moe = (isinstance(model_state, dict)
+                     and "moe_drop_fraction_metric" in model_state)
+        self.last_moe_drop_fraction: float | None = None
+        #: executed-batch count per height bucket (bench's seq-bucket
+        #: traffic attribution; cache.per_key has the compile hit/miss side)
+        self.seq_bucket_counts: dict = {}
+        if memory_budget_bytes is not None:
+            self.cache.set_budget(
+                memory_budget_bytes,
+                base_bytes=self.state_bytes_per_device()["total_bytes"])
+
+    @staticmethod
+    def _live_or_rule_sharding(tree, mesh, rules):
+        """Per-leaf: the leaf's own NamedSharding when it is already placed
+        on `mesh`, else the rule-derived spec."""
+        ruled = tree_sharding(tree, mesh, rules)
+
+        def pick(leaf, rule_shd):
+            shd = getattr(leaf, "sharding", None)
+            if isinstance(shd, NamedSharding) and shd.mesh == mesh:
+                return shd
+            return rule_shd
+
+        return jax.tree.map(pick, tree, ruled)
+
+    def state_bytes_per_device(self) -> dict:
+        """Per-device resident bytes of the SERVED weights under their
+        actual placements (shard-shape metadata — no transfer): the serve
+        analogue of `train.state.state_memory_bytes`, and the number an
+        fsdp-sharded restore divides by the data axis."""
+        from dist_mnist_tpu.train.state import _per_device_nbytes
+
+        out = {
+            "param_bytes": sum(_per_device_nbytes(x)
+                               for x in jax.tree.leaves(self.params)),
+            "model_state_bytes": sum(
+                _per_device_nbytes(x)
+                for x in jax.tree.leaves(self.model_state)),
+        }
+        out["total_bytes"] = out["param_bytes"] + out["model_state_bytes"]
+        return out
 
     # -- hot swap ------------------------------------------------------------
     def swap_weights(self, params, model_state, *, version: int | None = None,
@@ -217,7 +376,10 @@ class InferenceEngine:
         log.info("swapped weights (version=%s)", self.weights_version)
 
     # -- bucketing -----------------------------------------------------------
-    def bucket_for(self, n: int) -> int:
+    def bucket_for(self, n: int, height: int | None = None):
+        """Batch bucket for `n` requests — and, with `height`, the 2-D
+        (batch-bucket, height-bucket) grid cell a variable-length batch
+        executes in. `height=None` keeps the classic int return."""
         if n < 1:
             raise ValueError("empty batch")
         b = max(_pow2_at_least(n), self.min_bucket)
@@ -226,87 +388,215 @@ class InferenceEngine:
                 f"batch {n} needs bucket {b} > max_bucket {self.max_bucket}; "
                 "raise max_bucket or split the batch upstream"
             )
-        return b
+        if height is None:
+            return b
+        return b, self.seq_bucket_for(height)
+
+    def seq_bucket_for(self, height: int) -> int:
+        """Height bucket for one request height; without a seq grid only
+        the native height is servable."""
+        if self.seq_grid is None:
+            if height != self.image_shape[0]:
+                raise ValueError(
+                    f"height {height} != native {self.image_shape[0]} and "
+                    "this engine has no seq grid (serve/zoo.py)")
+            return height
+        return self.seq_grid.bucket_for(height)
 
     def buckets(self) -> list[int]:
-        """Every bucket size this engine can execute, smallest first."""
+        """Every batch bucket this engine can execute, smallest first."""
         out, b = [], self.min_bucket
         while b <= self.max_bucket:
             out.append(b)
             b *= 2
         return out
 
+    def grid(self) -> list[tuple[int, int]]:
+        """Every (batch-bucket, height-bucket) cell, the prewarm/rewarm
+        surface. Without a seq grid: one native-height column."""
+        heights = (list(self.seq_grid.heights) if self.seq_grid is not None
+                   else [self.image_shape[0]])
+        return [(b, h) for b in self.buckets() for h in heights]
+
     # -- compilation ---------------------------------------------------------
-    def _key(self, bucket: int):
+    # Variant contract: `height=None` is the maskless NATIVE program
+    # (bit-identical to eval); any explicit height — including the native
+    # one — is the masked variable-length variant at that height bucket.
+    # A masked native-shaped cell is reachable: a real height between the
+    # largest sub-native bucket and native rounds UP into the native
+    # bucket but still needs its padding masked.
+
+    def _native(self, height: int) -> bool:
+        return height == self.image_shape[0]
+
+    def _key(self, bucket: int, height: int | None = None):
+        h = self.image_shape[0] if height is None else height
         mesh_key = tuple(sorted(self.mesh.shape.items()))
-        return (self.model_name, (bucket, *self.image_shape), mesh_key,
-                "uint8->float32")
+        return (self.model_name, (bucket, h, *self.image_shape[1:]),
+                mesh_key, "uint8->float32",
+                "dense" if height is None else "masked")
 
-    def _compile(self, bucket: int):
-        def fwd(params, model_state, x):
-            x = x.astype(jnp.float32) / 255.0
-            logits, _ = self.model.apply(params, model_state, x, train=False)
-            return logits
+    def _compile(self, bucket: int, height: int | None = None):
+        h = self.image_shape[0] if height is None else height
+        if height is None:
+            # the maskless native program — bit-identical to
+            # train/step.py's eval forward on the same checkpoint
+            def fwd(params, model_state, x):
+                x = x.astype(jnp.float32) / 255.0
+                logits, out_state = self.model.apply(
+                    params, model_state, x, train=False)
+                if self._moe:
+                    return logits, out_state["moe_drop_fraction_metric"]
+                return logits
 
-        jitted = jax.jit(
-            fwd,
-            in_shardings=(self._param_shd, self._ms_shd, self._batch_shd),
-            out_shardings=self._batch_shd,
-        )
-        abstract_x = jax.ShapeDtypeStruct(
-            (bucket, *self.image_shape), jnp.uint8, sharding=self._batch_shd
-        )
-        return jitted.lower(self.params, self.model_state, abstract_x).compile()
+            in_shd = (self._param_shd, self._ms_shd, self._batch_shd)
+            abstract = (jax.ShapeDtypeStruct(
+                (bucket, *self.image_shape), jnp.uint8,
+                sharding=self._batch_shd),)
+        else:
+            # masked sub-native program: right-padded rows + a token mask
+            # (models' apply(mask=...); serve/zoo.SeqGrid semantics)
+            def fwd(params, model_state, x, mask):
+                x = x.astype(jnp.float32) / 255.0
+                logits, out_state = self.model.apply(
+                    params, model_state, x, train=False, mask=mask)
+                if self._moe:
+                    return logits, out_state["moe_drop_fraction_metric"]
+                return logits
 
-    def _store_key(self, bucket: int) -> str:
-        """Durable-store key for a bucket's program — same contract as the
-        train side (compilecache.cache_key folds jax/backend versions in)."""
+            n_tok = self.seq_grid.n_tokens(h)
+            in_shd = (self._param_shd, self._ms_shd, self._batch_shd,
+                      self._batch_shd)
+            abstract = (
+                jax.ShapeDtypeStruct((bucket, h, *self.image_shape[1:]),
+                                     jnp.uint8, sharding=self._batch_shd),
+                jax.ShapeDtypeStruct((bucket, n_tok), jnp.bool_,
+                                     sharding=self._batch_shd),
+            )
+        out_shd = ((self._batch_shd, NamedSharding(self.mesh, P()))
+                   if self._moe else self._batch_shd)
+        jitted = jax.jit(fwd, in_shardings=in_shd, out_shardings=out_shd)
+        return jitted.lower(self.params, self.model_state,
+                            *abstract).compile()
+
+    def _store_key(self, bucket: int, height: int | None = None) -> str:
+        """Durable-store key for a grid cell's program — same contract as
+        the train side (compilecache.cache_key folds jax/backend versions
+        in)."""
         from dist_mnist_tpu.compilecache import cache_key
 
-        return cache_key({
+        h = self.image_shape[0] if height is None else height
+        payload = {
             "kind": "serve",
             "model": self.model_name,
-            "input_shape": (bucket, *self.image_shape),
+            "input_shape": (bucket, h, *self.image_shape[1:]),
             "mesh": tuple(sorted(self.mesh.shape.items())),
             "dtype": "uint8->float32",
             "rules": self._rules,
-        })
+        }
+        # native cells keep the exact historical payload so a pre-zoo disk
+        # store stays warm across the upgrade; masked cells are new programs
+        if height is not None:
+            payload["variant"] = "masked"
+        if self._moe:
+            payload["moe_outputs"] = "drop_fraction"
+        return cache_key(payload)
 
-    def compiled_for(self, bucket: int):
+    def compiled_for(self, bucket: int, height: int | None = None):
         # key the disk tier only when one is wired — predict() lands here
         # per request and the hash need not be paid on the memory fast path
-        sk = (self._store_key(bucket)
+        sk = (self._store_key(bucket, height)
               if self.cache._store is not None else None)
-        return self.cache.get(self._key(bucket), lambda: self._compile(bucket),
-                              store_key=sk)
+        return self.cache.get(
+            self._key(bucket, height),
+            lambda: self._compile(bucket, height), store_key=sk)
 
-    def prewarm(self, buckets: list[int] | None = None) -> int:
-        """Compile the expected buckets up front (all of them by default) so
-        live traffic never waits on XLA. Returns the number compiled."""
+    def prewarm(self, buckets: list[int] | None = None,
+                heights: list[int] | None = None) -> int:
+        """Compile the expected (batch, height) grid up front (all of it by
+        default) so live traffic never waits on XLA. Returns the number
+        compiled. Under a memory budget this REFUSES (raises
+        `ServeMemoryBudgetError`) a grid whose executables evicted each
+        other while warming: a grid that cannot fit resident would turn
+        every live request into a recompile, which is exactly the p99 hole
+        prewarm exists to prevent — shrink the grid (fewer batch buckets /
+        coarser heights) or raise the budget."""
         n0 = self.cache.misses
+        ev0 = self.cache.evictions
+        variable = self.seq_grid is not None and not self.seq_grid.native_only
+        if heights is None:
+            heights = (list(self.seq_grid.heights) if variable else [])
         for b in buckets if buckets is not None else self.buckets():
-            self.compiled_for(self.bucket_for(b))
+            bb = self.bucket_for(b)
+            # dense native cell first (the bit-parity program every
+            # full-length request runs), then — variable-length engines —
+            # the masked cell per height, INCLUDING the masked native-
+            # shaped one (real heights rounding up into the native bucket
+            # land there; skipping it would be a hot-path recompile)
+            self.compiled_for(bb)
+            for h in heights:
+                self.compiled_for(bb, h)
+        if self.cache.evictions > ev0:
+            raise ServeMemoryBudgetError(
+                f"prewarm grid does not fit the serve memory budget "
+                f"({self.cache.budget_bytes} B): "
+                f"{self.cache.evictions - ev0} eviction(s) during warmup "
+                "— the grid would thrash under live traffic; shrink it or "
+                "raise --serve_memory_budget_mb")
         return self.cache.misses - n0
 
     # -- execution -----------------------------------------------------------
-    def predict(self, images: np.ndarray) -> np.ndarray:
-        """Logits for `images` [n, *image_shape]; pads to the bucket, runs
-        the cached executable, unpads. The executed-batch clock stops on the
-        device_get of the logits (utils/timing.py discipline)."""
+    def predict(self, images: np.ndarray,
+                heights: np.ndarray | None = None) -> np.ndarray:
+        """Logits for `images` [n, h, W, C]; pads to the (batch, height)
+        grid cell, runs the cached executable, unpads. `h` may be any
+        servable height when the engine has a seq grid (the batcher groups
+        requests by height first); `heights` optionally carries each row's
+        REAL height when rows were already padded to a common `h`. The
+        executed-batch clock stops on the device_get of the logits
+        (utils/timing.py discipline)."""
         images = np.asarray(images)
-        if images.shape[1:] != self.image_shape:
+        if images.shape[2:] != self.image_shape[1:] or images.ndim != 4:
             raise ValueError(
                 f"image shape {images.shape[1:]} != engine's {self.image_shape}"
             )
-        n = images.shape[0]
+        n, h = images.shape[0], images.shape[1]
         bucket = self.bucket_for(n)
-        exe = self.compiled_for(bucket)
+        h_bucket = self.seq_bucket_for(h)
+        real_h = (np.full((n,), h) if heights is None
+                  else np.asarray(heights))
+        # the native cell runs the maskless bit-parity program only when no
+        # row is actually short; short rows rounded into the native bucket
+        # use the masked native-shaped variant
+        masked = (not self._native(h_bucket)) or bool(
+            np.any(real_h < self.image_shape[0]))
+        if masked and self.seq_grid is None:
+            raise ValueError(
+                "variable-length rows need a seq grid (serve/zoo.py)")
+        exe = self.compiled_for(bucket,
+                                h_bucket if masked else None)
+        if h < h_bucket:
+            pad = np.zeros((n, h_bucket - h, *self.image_shape[1:]),
+                           dtype=np.uint8)
+            images = np.concatenate([images.astype(np.uint8), pad], axis=1)
         if n < bucket:
-            pad = np.zeros((bucket - n, *self.image_shape), dtype=np.uint8)
+            pad = np.zeros((bucket - n, h_bucket, *self.image_shape[1:]),
+                           dtype=np.uint8)
             images = np.concatenate([images.astype(np.uint8), pad])
-        x = jax.device_put(images.astype(np.uint8), self._batch_shd)
+        args = [jax.device_put(images.astype(np.uint8), self._batch_shd)]
+        if masked:
+            mask = np.zeros((bucket, self.seq_grid.n_tokens(h_bucket)),
+                            dtype=bool)
+            mask[:n] = self.seq_grid.mask(real_h, h_bucket)
+            args.append(jax.device_put(mask, self._batch_shd))
+        self.seq_bucket_counts[h_bucket] = \
+            self.seq_bucket_counts.get(h_bucket, 0) + 1
         with stopclock(self.cache.times, "execute"):
-            logits = np.asarray(
-                jax.device_get(exe(self.params, self.model_state, x))
-            )
-        return logits[:n]
+            out = jax.device_get(exe(self.params, self.model_state, *args))
+        if self._moe:
+            logits, drop = out
+            self.last_moe_drop_fraction = float(drop)
+        else:
+            logits = out
+            self.last_moe_drop_fraction = None
+        return np.asarray(logits)[:n]

@@ -56,13 +56,26 @@ def load_for_serving(
     *,
     checkpoint_dir: str | Path | None = None,
     step: int | None = None,
+    sharding_rules: str | ShardingRules | None = None,
 ) -> ServingBundle:
     """Build everything `InferenceEngine` needs from a config (+ optional
-    checkpoint directory). `cfg` may be a config name or a Config."""
+    checkpoint directory). `cfg` may be a config name or a Config.
+
+    `sharding_rules` overrides the config's TRAIN-time strategy for the
+    serve placement (cross-strategy restore, e.g. an fsdp-trained
+    checkpoint served under tp): the abstract restore targets are built
+    with the SERVE rules, so `restore_weights` lands each leaf directly in
+    its serve-time shard layout — `parallel/sharding.py` does the
+    resharding by construction, no full replica ever materializes."""
     if isinstance(cfg, str):
         cfg = get_config(cfg)
     model = get_model(cfg.model, **cfg.model_kwargs)
-    rules = resolve_rules(cfg.sharding_rules)
+    if sharding_rules is None:
+        rules = resolve_rules(cfg.sharding_rules)
+    elif isinstance(sharding_rules, str):
+        rules = resolve_rules(sharding_rules)
+    else:
+        rules = sharding_rules
     info = DATASETS[cfg.dataset]
     image_shape = tuple(info["image_shape"])
     sample = jnp.zeros((1, *image_shape), jnp.float32)

@@ -118,6 +118,110 @@ def run_loadgen(
     return summary
 
 
+def make_varlen_images(image_shape: tuple[int, ...], patch: int,
+                       seed: int = 0, n: int = _POOL) -> list[np.ndarray]:
+    """Seeded pool of variable-HEIGHT images for the zoo's long-context
+    path: each entry's height is a patch-multiple drawn uniformly from
+    [patch, native], width/channels fixed. Patch-multiple heights keep
+    every patch token fully real (models/vit.py's VALID patch conv would
+    otherwise mix real and pad pixels inside one token)."""
+    native_h = image_shape[0]
+    rest = tuple(image_shape[1:])
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(1, native_h // patch + 1, size=n)
+    return [rng.integers(0, 256, size=(int(k) * patch, *rest),
+                         dtype=np.uint8) for k in ks]
+
+
+def run_longctx_loadgen(
+    server,
+    *,
+    n_requests: int,
+    concurrency: int,
+    seed: int = 0,
+    deadline_ms: float | None = None,
+    timeout: float = 240.0,
+) -> dict:
+    """`run_loadgen` for a zoo engine's 2-D grid: variable-height seeded
+    traffic, plus the per-seq-bucket routing counters and compile-cache
+    hit/miss deltas that prove the grid absorbed every shape without a
+    hot-path recompile. Requires `server.engine.seq_grid`."""
+    grid = getattr(server.engine, "seq_grid", None)
+    if grid is None:
+        raise ValueError("run_longctx_loadgen needs a seq-grid engine "
+                         "(serve/zoo.py build_zoo_engine seq_buckets=...)")
+    images = make_varlen_images(
+        (grid.native_height, grid.width, grid.channels), grid.patch,
+        seed=seed)
+    cache0 = server.engine.cache.stats()
+    buckets0 = dict(server.engine.seq_bucket_counts)
+    window = threading.Semaphore(concurrency)
+    futures = []
+    rejected_queue_full = 0
+    rejected_shutdown = 0
+
+    for i in range(n_requests):
+        window.acquire()
+        try:
+            fut = server.submit(images[i % len(images)],
+                                deadline_ms=deadline_ms)
+        except QueueFullError:
+            rejected_queue_full += 1
+            window.release()
+            continue
+        except ShuttingDownError:
+            rejected_shutdown += 1
+            window.release()
+            continue
+        fut.add_done_callback(lambda _f: window.release())
+        futures.append(fut)
+
+    ok = 0
+    deadline_expired = 0
+    errors = 0
+    latencies = []
+    for fut in futures:
+        try:
+            res = fut.result(timeout=timeout)
+        except DeadlineExceededError:
+            deadline_expired += 1
+            continue
+        except Exception:
+            errors += 1
+            continue
+        ok += 1
+        latencies.append(res.latency_ms)
+
+    summary = _pct(np.asarray(latencies, dtype=np.float64))
+    summary.update(
+        n_requests=n_requests,
+        concurrency=concurrency,
+        ok=ok,
+        rejected_queue_full=rejected_queue_full,
+        rejected_shutdown=rejected_shutdown,
+        deadline_expired=deadline_expired,
+        errors=errors,
+    )
+    cache1 = server.engine.cache.stats()
+    summary["cache"] = cache1
+    # compiles that happened DURING the timed traffic — 0 after a full
+    # prewarm is the zoo's no-recompile guarantee
+    summary["recompiles_during_traffic"] = \
+        cache1["misses"] - cache0["misses"]
+    counts = server.engine.seq_bucket_counts
+    summary["seq_bucket_counts"] = {
+        str(h): counts.get(h, 0) - buckets0.get(h, 0)
+        for h in grid.heights
+        if counts.get(h, 0) - buckets0.get(h, 0)
+    }
+    stats = server.stats()
+    summary["mean_batch_size"] = stats["mean_batch_size"]
+    summary["mean_occupancy"] = stats["mean_occupancy"]
+    summary["mean_seq_occupancy"] = stats.get("mean_seq_occupancy", 1.0)
+    summary["n_batches"] = stats["n_batches"]
+    return summary
+
+
 def _pct(lat: np.ndarray) -> dict:
     if not lat.size:
         return {"p50_ms": float("nan"), "p95_ms": float("nan"),

@@ -43,6 +43,11 @@ class ServeMetrics:
         self.latency_ms = StreamingHistogram()
         self.batch_size = StreamingHistogram()
         self.batch_occupancy = StreamingHistogram()
+        # zoo serving (serve/zoo.py): sequence-padding waste per executed
+        # group (real tokens / padded tokens) and MoE routed-overflow drops
+        # — both empty forever on a native-only dense engine
+        self.seq_occupancy = StreamingHistogram()
+        self.moe_drop_fraction = StreamingHistogram()
 
     def attach_to(self, registry) -> None:
         """Expose the live ladders on a MetricRegistry (-> /metrics)."""
@@ -50,6 +55,9 @@ class ServeMetrics:
         registry.attach_histogram("serve/batch_size", self.batch_size)
         registry.attach_histogram("serve/batch_occupancy",
                                   self.batch_occupancy)
+        registry.attach_histogram("serve/seq_occupancy", self.seq_occupancy)
+        registry.attach_histogram("serve/moe_drop_fraction",
+                                  self.moe_drop_fraction)
 
     def record_admitted(self):
         with self._lock:
@@ -85,10 +93,19 @@ class ServeMetrics:
             return self.admitted - (self.completed + self.rejected_deadline
                                     + self.failed + self.cancelled)
 
-    def record_batch(self, n_real: int, bucket: int):
-        """One executed batch: `n_real` genuine requests padded to `bucket`."""
+    def record_batch(self, n_real: int, bucket: int,
+                     seq_occupancy: float | None = None,
+                     moe_drop_fraction: float | None = None):
+        """One executed batch: `n_real` genuine requests padded to `bucket`.
+        `seq_occupancy` (real tokens / padded tokens, serve/zoo.py seq
+        buckets) and `moe_drop_fraction` (routed-overflow drops of an MoE
+        forward) ride along when the engine produces them."""
         self.batch_size.observe(n_real)
         self.batch_occupancy.observe(n_real / bucket)
+        if seq_occupancy is not None:
+            self.seq_occupancy.observe(seq_occupancy)
+        if moe_drop_fraction is not None:
+            self.moe_drop_fraction.observe(moe_drop_fraction)
 
     def record_latency(self, ms: float, n: int = 1):
         self.latency_ms.observe(ms)
@@ -122,6 +139,13 @@ class ServeMetrics:
         out.update(pct)
         out["mean_batch_size"] = sizes["mean"] if sizes["count"] else 0.0
         out["mean_occupancy"] = occ["mean"] if occ["count"] else 0.0
+        seq = self.seq_occupancy.snapshot()
+        if seq["count"]:
+            out["mean_seq_occupancy"] = seq["mean"]
+        drop = self.moe_drop_fraction.snapshot()
+        if drop["count"]:
+            out["mean_moe_drop_fraction"] = drop["mean"]
+            out["max_moe_drop_fraction"] = drop.get("max", drop["mean"])
         return out
 
     def emit(self, writer, step: int, *, queue_depth: int | None = None,
@@ -142,11 +166,20 @@ class ServeMetrics:
             vals[f"serve/{tag}"] = snap[tag]
         vals["serve/mean_batch_size"] = snap["mean_batch_size"]
         vals["serve/mean_occupancy"] = snap["mean_occupancy"]
+        if "mean_seq_occupancy" in snap:
+            vals["serve/mean_seq_occupancy"] = snap["mean_seq_occupancy"]
+        if "mean_moe_drop_fraction" in snap:
+            vals["serve/mean_moe_drop_fraction"] = \
+                snap["mean_moe_drop_fraction"]
         if queue_depth is not None:
             vals["serve/queue_depth"] = queue_depth
         if cache:
             vals["serve/cache_hits"] = cache.get("hits", 0)
             vals["serve/cache_misses"] = cache.get("misses", 0)
+            if cache.get("evictions"):
+                vals["serve/cache_evictions"] = cache["evictions"]
+            if cache.get("resident_bytes"):
+                vals["serve/resident_bytes"] = cache["resident_bytes"]
         batch_write = getattr(writer, "scalars", None)
         if callable(batch_write):
             batch_write(vals, step)

@@ -288,9 +288,11 @@ class InProcessReplica:
         self.server.engine.swap_weights(params, model_state, version=step)
 
     def rewarm(self) -> float:
-        """Re-touch every served bucket post-swap; returns wall ms. Pure
+        """Re-touch every served grid cell post-swap; returns wall ms. Pure
         memory-tier hits for a live engine (executables survive the swap);
-        the disk tier covers a restarted one."""
+        the disk tier covers a restarted one. On a zoo engine (serve/zoo.py)
+        prewarm defaults its heights to the full sequence grid, so this
+        walks the whole 2-D (batch, height) grid, not just batch buckets."""
         t0 = time.perf_counter()
         eng = self.server.engine
         eng.prewarm([b for b in eng.buckets()

@@ -30,11 +30,17 @@ log = logging.getLogger(__name__)
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
-    max_batch: int = 64  # coalesce ceiling; must be <= engine max_bucket
+    max_batch: int = 64  # coalesce ceiling; beyond engine max_bucket the
+    # batcher splits the window into bucket-sized executions
     max_wait_ms: float = 2.0  # coalesce window opened by the first request
     queue_depth: int = 256  # admission bound; beyond it -> QueueFullError
     default_deadline_ms: float | None = None  # per-request override wins
-    prewarm: bool = True  # compile all buckets before serving
+    prewarm: bool = True  # compile the (batch, height) grid before serving
+    prewarm_async: bool = False  # warm the grid on a background
+    # "ZooPrewarm" thread while traffic is already served: first requests
+    # may pay an on-demand compile, but startup latency stays flat as the
+    # 2-D zoo grid multiplies the cell count (serve/zoo.py). The thread is
+    # joined by close(); a budget refusal surfaces in stats()["prewarm_error"]
 
 
 class InferenceServer:
@@ -56,17 +62,37 @@ class InferenceServer:
         )
         self._started = False
         self._closed = False
+        self._prewarm_thread: "threading.Thread | None" = None
+        self._prewarm_error: Exception | None = None
 
     # -- lifecycle -----------------------------------------------------------
+    def _prewarm_buckets(self) -> list[int]:
+        return [b for b in self.engine.buckets()
+                if b <= max(self.config.max_batch, self.engine.min_bucket)]
+
+    def _prewarm(self) -> None:
+        try:
+            n = self.engine.prewarm(self._prewarm_buckets())
+            log.info("prewarmed %d executable(s) over buckets %s", n,
+                     self.engine.buckets())
+        except Exception as err:  # surface via stats(); keep serving dense
+            log.exception("background prewarm failed")
+            self._prewarm_error = err
+
     def start(self) -> "InferenceServer":
         if self._started:
             return self
         if self.config.prewarm:
-            n = self.engine.prewarm(
-                [b for b in self.engine.buckets()
-                 if b <= max(self.config.max_batch, self.engine.min_bucket)]
-            )
-            log.info("prewarmed %d bucket(s): %s", n, self.engine.buckets())
+            if self.config.prewarm_async:
+                import threading
+
+                self._prewarm_thread = threading.Thread(
+                    target=self._prewarm, name="ZooPrewarm", daemon=True)
+                self._prewarm_thread.start()
+            else:
+                n = self.engine.prewarm(self._prewarm_buckets())
+                log.info("prewarmed %d executable(s) over buckets %s", n,
+                         self.engine.buckets())
         self._batcher.start()
         self._started = True
         if self.health is not None:
@@ -85,6 +111,11 @@ class InferenceServer:
 
         if self.health is not None:
             self.health.set("draining")
+        if self._prewarm_thread is not None:
+            # bounded join: an in-flight compile finishes, then the thread
+            # exits — close() never leaks a ZooPrewarm thread past itself
+            self._prewarm_thread.join(timeout=timeout)
+            self._prewarm_thread = None
         self._admission.close()
         ok = self._batcher.drain(timeout=timeout) if self._started else True
         if not ok:
@@ -145,6 +176,8 @@ class InferenceServer:
         out = self.metrics.snapshot()
         out["queue_depth"] = self.queue_depth
         out["cache"] = self.engine.cache.stats()
+        if self._prewarm_error is not None:
+            out["prewarm_error"] = repr(self._prewarm_error)
         return out
 
     def emit_metrics(self, writer, step: int = 0) -> None:

@@ -1,0 +1,258 @@
+"""Model-zoo serving: everything the trainer can produce, the engine can
+serve — variable-length inputs, MoE checkpoints, and TP/fsdp-sharded
+weights, under an explicit per-device memory budget.
+
+This module is the PLANNING layer (no device transfers, no host syncs —
+it is in scripts/check_host_sync.py's lint scope): it decides the
+(batch-bucket, seq-bucket) grid, builds token masks, attributes per-device
+resident bytes, and constructs a fully-wired `InferenceEngine`. The
+execution surgery lives in serve/engine.py.
+
+The four zoo problems and where each is solved:
+
+1. **Variable length.** Requests shorter than the init-time native shape
+   (fewer image rows -> fewer ViT patch tokens) are right-padded UP to a
+   power-of-two height bucket and served with a token mask
+   (`models/vit.py apply(mask=...)`), so a short request's logits equal
+   running it unpadded — while the executable count stays
+   O(log2(max_batch) * log2(native_h)) instead of one per request shape.
+   The native bucket keeps the historical MASKLESS program, bit-identical
+   to `make_eval_step` on the same checkpoint.
+2. **MoE.** `moe_ffn_adaptive` already runs at inference; the zoo adds an
+   inference-time capacity factor (`dataclasses.replace` on the frozen
+   model — params are capacity-independent) and the engine returns the
+   routed `moe_drop_fraction_metric` alongside the logits so expert
+   overflow is a serve metric, never silent truncation. Capacity is a
+   static function of the bucket's token count, so token imbalance can
+   never change the compiled program.
+3. **Sharding.** The engine pins its in_shardings off the LIVE weights'
+   placements (the `make_eval_step` idiom) — a TP/fsdp/fsdp_tp restore
+   serves resident-sharded instead of being silently replicated; the
+   loader's `sharding_rules` override re-lands a checkpoint trained under
+   one strategy onto another (`parallel/sharding.py` does the resharding
+   by construction of the restore targets).
+4. **Memory budget.** `per_device_state_bytes` (shard-shape metadata, the
+   `state_memory_bytes` discipline) plus per-executable bytes are held
+   under `--serve_memory_budget_mb` by the compiled-model cache's LRU
+   tier; `prewarm` REFUSES a grid that cannot fit rather than thrashing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import logging
+
+import numpy as np
+
+from dist_mnist_tpu.serve.engine import CompiledModelCache, InferenceEngine
+
+log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# sequence (height) bucketing
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqGrid:
+    """The sequence-bucket axis of the 2-D serve grid.
+
+    "Sequence length" for this zoo's image classifiers is the token count a
+    ViT derives from the image HEIGHT: ceil(h / patch) patch-rows of
+    (width / patch) tokens each, in row-major order — so right-padding
+    image rows pads whole trailing patch tokens (patches never straddle
+    the real/pad boundary: the patch conv is stride == kernel == patch),
+    and the learned position table's leading rows are exactly the real
+    tokens' positions. `heights` are the bucket ceilings, ascending,
+    multiples of `patch`, with the init-time native height always last:
+    the native bucket serves the maskless bit-parity program, every
+    sub-native bucket serves the masked variant.
+    """
+
+    native_height: int
+    width: int
+    channels: int
+    patch: int
+    heights: tuple[int, ...]
+
+    def __post_init__(self):
+        hs = tuple(sorted(set(int(h) for h in self.heights)))
+        if not hs or hs[-1] != self.native_height:
+            hs = tuple(h for h in hs if h < self.native_height) \
+                + (self.native_height,)
+        for h in hs:
+            if h < 1 or h > self.native_height:
+                raise ValueError(
+                    f"seq bucket height {h} outside (0, native "
+                    f"{self.native_height}]")
+            if h % self.patch:
+                raise ValueError(
+                    f"seq bucket height {h} not a multiple of patch "
+                    f"{self.patch} — a partial patch-row would drop real "
+                    "pixels in the VALID patch conv")
+        object.__setattr__(self, "heights", hs)
+
+    @property
+    def native_only(self) -> bool:
+        return self.heights == (self.native_height,)
+
+    def bucket_for(self, h: int) -> int:
+        """Smallest bucket ceiling >= h; raises above native (the learned
+        position table has no rows for unseen tokens)."""
+        if h < 1:
+            raise ValueError("empty image (height < 1)")
+        for b in self.heights:
+            if h <= b:
+                return b
+        raise ValueError(
+            f"height {h} > native {self.native_height}: the checkpoint's "
+            "position table ends there; retrain with a larger native shape")
+
+    def n_tokens(self, h: int) -> int:
+        """Patch tokens (excluding any CLS) for an image of height `h`."""
+        return (-(-h // self.patch)) * (self.width // self.patch)
+
+    def mask(self, real_heights, bucket_h: int) -> np.ndarray:
+        """[B, n_tokens(bucket_h)] bool — True on each row's real patch
+        tokens. Row-major patch order means row i's first
+        `n_tokens(real_heights[i])` tokens are the real ones."""
+        real_heights = np.asarray(real_heights, dtype=np.int64)
+        s = self.n_tokens(bucket_h)
+        real = np.array([self.n_tokens(int(h)) for h in real_heights])
+        return (np.arange(s)[None, :] < real[:, None])
+
+
+def default_seq_grid(image_shape, patch: int) -> SeqGrid:
+    """Power-of-two height ladder: patch, 2*patch, 4*patch, ... up to (and
+    always including) the native height."""
+    native_h, width, channels = (int(d) for d in image_shape)
+    heights, h = [], patch
+    while h < native_h:
+        heights.append(h)
+        h *= 2
+    heights.append(native_h)
+    return SeqGrid(native_height=native_h, width=width, channels=channels,
+                   patch=patch, heights=tuple(heights))
+
+
+def parse_seq_buckets(spec: str | None, image_shape,
+                      patch: int) -> SeqGrid | None:
+    """CLI surface: None/"" -> no seq grid (native-only engine, exactly
+    the pre-zoo behavior); "auto" -> `default_seq_grid`; "h1,h2,..." ->
+    explicit bucket ceilings (native appended if missing)."""
+    if not spec:
+        return None
+    if spec == "auto":
+        return default_seq_grid(image_shape, patch)
+    native_h, width, channels = (int(d) for d in image_shape)
+    heights = tuple(int(tok) for tok in spec.split(","))
+    return SeqGrid(native_height=native_h, width=width, channels=channels,
+                   patch=patch, heights=heights)
+
+
+def supports_mask(model) -> bool:
+    """True when `model.apply` can honor a token mask: it takes a `mask`
+    kwarg AND its attention path is the maskable einsum one ("xla" — the
+    Pallas/ring/ulysses kernels take no mask argument). Models without
+    mask support degenerate to the native-only grid."""
+    try:
+        if "mask" not in inspect.signature(model.apply).parameters:
+            return False
+    except (TypeError, ValueError):
+        return False
+    if getattr(model, "attention_impl", "xla") != "xla":
+        return False
+    if getattr(model, "block_pipeline", 0):
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# per-device memory attribution
+
+
+def per_device_state_bytes(params, model_state) -> dict:
+    """Bytes ONE device holds for the served weights under their ACTUAL
+    placements — `train.state.state_memory_bytes`'s discipline (pure
+    shard-shape metadata: no transfer, no sync), minus the optimizer slots
+    serving never loads. This is the number fsdp shrinks: an fsdp-restored
+    tree costs ~1/data-axis of the replicated dense baseline per device."""
+    from dist_mnist_tpu.train.state import _per_device_nbytes
+
+    import jax
+
+    out = {
+        "param_bytes": sum(_per_device_nbytes(x)
+                           for x in jax.tree.leaves(params)),
+        "model_state_bytes": sum(_per_device_nbytes(x)
+                                 for x in jax.tree.leaves(model_state)),
+    }
+    out["total_bytes"] = out["param_bytes"] + out["model_state_bytes"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine construction
+
+
+def build_zoo_engine(
+    bundle,
+    mesh,
+    *,
+    model_name: str,
+    max_bucket: int = 256,
+    seq_buckets: str | SeqGrid | None = None,
+    moe_capacity_factor: float | None = None,
+    memory_budget_mb: float | None = None,
+    store=None,
+    cache: CompiledModelCache | None = None,
+) -> InferenceEngine:
+    """One factory for every checkpoint the trainer can produce: wires the
+    seq grid (when the model can honor masks), the inference-time MoE
+    capacity override, the live-placement sharding pin, and the memory
+    budget into an `InferenceEngine`. With every knob at its default this
+    constructs exactly the pre-zoo engine.
+
+    `bundle` is a `loader.ServingBundle` (or anything with .model/.params/
+    .model_state/.image_shape/.rules).
+    """
+    model = bundle.model
+    if moe_capacity_factor is not None:
+        if not (dataclasses.is_dataclass(model)
+                and any(f.name == "moe_capacity_factor"
+                        for f in dataclasses.fields(model))):
+            raise ValueError(
+                f"--moe_capacity_factor given but model {model_name!r} has "
+                "no moe_capacity_factor field")
+        # params are capacity-independent: the factor only sizes the
+        # routing buffers inside the traced program, so the restored
+        # weights serve unchanged under the new capacity
+        model = dataclasses.replace(
+            model,
+            moe_capacity_factor=float(  # host-sync-ok: CLI scalar, no device
+                moe_capacity_factor))
+
+    grid = seq_buckets
+    if isinstance(seq_buckets, str):
+        grid = parse_seq_buckets(
+            seq_buckets, bundle.image_shape, getattr(model, "patch", 1))
+    if grid is not None and not supports_mask(model):
+        if not grid.native_only:
+            log.warning(
+                "model %r cannot honor token masks (no mask kwarg, kernel "
+                "attention, or block pipeline) — variable-length buckets "
+                "%s collapse to the native-only grid",
+                model_name, grid.heights)
+        grid = SeqGrid(native_height=grid.native_height, width=grid.width,
+                       channels=grid.channels, patch=grid.patch,
+                       heights=(grid.native_height,))
+
+    budget_bytes = (int(memory_budget_mb * 1024 * 1024)
+                    if memory_budget_mb else None)
+    return InferenceEngine(
+        model, bundle.params, bundle.model_state, mesh,
+        model_name=model_name, image_shape=bundle.image_shape,
+        rules=bundle.rules, max_bucket=max_bucket, store=store, cache=cache,
+        seq_grid=grid, memory_budget_bytes=budget_bytes,
+    )

@@ -60,6 +60,9 @@ def default_targets(repo_root: Path) -> list[Path]:
     # one host sync there serializes exactly what it exists to overlap
     targets += [pkg / "data" / "prefetch.py", pkg / "hooks" / "builtin.py",
                 pkg / "parallel" / "overlap.py"]
+    # serve/zoo.py is the zoo's PLANNING layer: grid/mask/byte accounting
+    # must stay metadata-only — every device transfer belongs in engine.py
+    targets += [pkg / "serve" / "zoo.py"]
     return [t for t in targets if t.exists()]
 
 

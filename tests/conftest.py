@@ -72,7 +72,9 @@ def _no_leaked_prefetch_workers():
     rest of the session), fleet-router threads/registries (``Router*`` —
     RouterHealth/RouterTimer/RouterWatcher/RouterHttp pools,
     serve/router.py's ``_LIVE_ROUTERS``, and cli/router.py's
-    ``_LIVE_REPLICA_PROCS`` subprocess replicas), and
+    ``_LIVE_REPLICA_PROCS`` subprocess replicas), background zoo-grid
+    prewarm threads (``ZooPrewarm`` — serve/server.py's async prewarm must
+    be joined by close()), and
     warm-start/coldstart/journal temp dirs
     created OUTSIDE pytest's tmp root (launch()'s supervisor mkdtemp and
     bench.py's coldstart pair dir must clean up after themselves). Polls
@@ -102,6 +104,7 @@ def _no_leaked_prefetch_workers():
                        or t.name.startswith("CompileCache")
                        or t.name.startswith("SnapshotWriter")
                        or t.name.startswith("ObsExporter")
+                       or t.name.startswith("ZooPrewarm")
                        or t.name.startswith("Router"))]
         exporter_mod = sys.modules.get("dist_mnist_tpu.obs.exporter")
         if exporter_mod is not None:

@@ -1,0 +1,422 @@
+"""Tier-1 model-zoo serving tests (serve/zoo.py + the engine's 2-D grid):
+variable-length masked serving, MoE capacity at inference, cross-strategy
+(fsdp-trained -> TP-served) restore, the per-device memory budget, and the
+batcher's oversized-window split. All CPU-mesh; models are kept tiny
+(depth 1, dim 16-32) so every compile stays in the tier-1 time budget."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.models.registry import get_model
+from dist_mnist_tpu.parallel.sharding import resolve_rules
+from dist_mnist_tpu.serve import (
+    InferenceServer,
+    SeqGrid,
+    ServeConfig,
+    ServeMemoryBudgetError,
+    build_zoo_engine,
+    default_seq_grid,
+    load_for_serving,
+    parse_seq_buckets,
+    supports_mask,
+)
+from dist_mnist_tpu.serve.engine import CompiledModelCache, InferenceEngine
+
+IMAGE_SHAPE = (16, 16, 3)  # native height 16, patch 4 -> ladder 4, 8, 16
+
+
+def _tiny_vit(**kw):
+    kwargs = dict(depth=1, dim=16, heads=2, patch=4, pool="mean")
+    kwargs.update(kw)
+    return get_model("vit_tiny", **kwargs)
+
+
+def _images(n, h=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(n, h, *IMAGE_SHAPE[1:]),
+                        dtype=np.uint8)
+
+
+def _reference_logits(model, params, ms, images):
+    """The engine's normalization contract (x/255) applied directly."""
+    x = jnp.asarray(images, jnp.float32) / 255.0
+    logits, _ = model.apply(params, ms, x, train=False)
+    return np.asarray(logits)
+
+
+@pytest.fixture(scope="module")
+def zoo_engine(mesh8):
+    """Maskable tiny ViT behind the auto height ladder on the 8-way mesh."""
+    model = _tiny_vit()
+    params, ms = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, *IMAGE_SHAPE), jnp.float32))
+    return InferenceEngine(
+        model, params, ms, mesh8, model_name="vit_zoo",
+        image_shape=IMAGE_SHAPE, rules=resolve_rules("dp"), max_bucket=16,
+        seq_grid=default_seq_grid(IMAGE_SHAPE, 4),
+    )
+
+
+# -- SeqGrid planning layer ---------------------------------------------------
+
+def test_seq_grid_buckets_and_tokens():
+    grid = default_seq_grid(IMAGE_SHAPE, 4)
+    assert grid.heights == (4, 8, 16)
+    assert [grid.bucket_for(h) for h in (1, 4, 5, 8, 9, 16)] == \
+        [4, 4, 8, 8, 16, 16]
+    with pytest.raises(ValueError, match="native"):
+        grid.bucket_for(17)
+    # 4 tokens per patch-row of width 16 / patch 4
+    assert grid.n_tokens(4) == 4 and grid.n_tokens(16) == 16
+    mask = grid.mask([4, 8], bucket_h=8)
+    assert mask.shape == (2, 8)
+    assert mask[0].tolist() == [True] * 4 + [False] * 4
+    assert mask[1].all()
+
+
+def test_seq_grid_validation_and_parse():
+    with pytest.raises(ValueError, match="patch"):
+        SeqGrid(native_height=16, width=16, channels=3, patch=4,
+                heights=(6, 16))
+    assert parse_seq_buckets(None, IMAGE_SHAPE, 4) is None
+    assert parse_seq_buckets("auto", IMAGE_SHAPE, 4).heights == (4, 8, 16)
+    # native appended when the explicit spec leaves it out
+    assert parse_seq_buckets("8", IMAGE_SHAPE, 4).heights == (8, 16)
+
+
+def test_supports_mask_gates_kernel_attention():
+    assert supports_mask(_tiny_vit())
+    assert not supports_mask(_tiny_vit(attention_impl="flash"))
+    assert not supports_mask(get_model("mlp"))
+
+
+# -- 2-D grid: keys, prewarm, no-recompile hot path ---------------------------
+
+def test_grid_cache_keys_distinguish_batch_seq_and_variant(zoo_engine):
+    e = zoo_engine
+    assert e.grid() == [(8, 4), (8, 8), (8, 16), (16, 4), (16, 8), (16, 16)]
+    # dense native, masked native, and masked sub-native are DIFFERENT
+    # programs — one key each, per batch bucket
+    keys = {e._key(8), e._key(8, 16), e._key(8, 8), e._key(16, 8)}
+    assert len(keys) == 4
+
+
+def test_prewarm_compiles_grid_then_zero_recompiles(zoo_engine):
+    e = zoo_engine
+    n = e.prewarm()
+    # per batch bucket: 1 dense native + one masked program per height
+    assert n == len(e.buckets()) * (1 + len(e.seq_grid.heights))
+    misses0 = e.cache.stats()["misses"]
+    # arbitrary (batch, height) traffic over the warmed grid: heights that
+    # round up into every bucket, including the masked-native cell (h=9..16
+    # rounds into 16 but still needs its padding masked when short)
+    for n_req, h in [(1, 3), (5, 8), (2, 12), (16, 16), (3, 5)]:
+        out = e.predict(_images(n_req, h=h, seed=h))
+        assert out.shape == (n_req, 10)
+    assert e.cache.stats()["misses"] == misses0, "hot-path recompile"
+    assert e.prewarm() == 0  # idempotent: everything already resident
+    assert sum(e.seq_bucket_counts.values()) >= 5
+
+
+def test_masked_short_request_matches_unpadded_forward(zoo_engine):
+    e = zoo_engine
+    model, params, ms = e.model, e.params, e.model_state
+    # bf16 compute: batch padding + the masked program shift reduction
+    # order by 1-2 ulp; a WRONG mask moves logits by whole units
+    for h in (4, 8, 12):
+        images = _images(3, h=h, seed=h)
+        got = e.predict(images)
+        want = _reference_logits(model, params, ms, images)
+        np.testing.assert_allclose(got, want, atol=0.04, rtol=0.04)
+
+
+def test_native_dense_path_is_maskless(zoo_engine):
+    e = zoo_engine
+    images = _images(4, h=16, seed=1)
+    got = e.predict(images)
+    want = _reference_logits(e.model, e.params, e.model_state, images)
+    np.testing.assert_allclose(got, want, atol=0.04, rtol=0.04)
+    # full-height traffic routed through the DENSE (maskless) program
+    assert e.cache.per_key[e._key(8)]["hits"] >= 1
+
+
+# -- MoE serving --------------------------------------------------------------
+
+def test_moe_serve_matches_train_forward_and_reports_drops(mesh_tp):
+    # n_experts == model-axis size -> the expert-parallel moe_ffn path
+    model = _tiny_vit(mlp_impl="moe", n_experts=2)
+    params, ms = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, *IMAGE_SHAPE), jnp.float32))
+    assert "moe_drop_fraction_metric" in ms
+    engine = InferenceEngine(
+        model, params, ms, mesh_tp, model_name="vit_moe",
+        image_shape=IMAGE_SHAPE, rules=resolve_rules("tp"), max_bucket=8,
+    )
+    images = _images(8, h=16, seed=2)
+    got = engine.predict(images)
+    want = _reference_logits(model, params, ms, images)
+    # bf16 + expert-parallel dispatch vs the unsharded reference: ulp-level
+    np.testing.assert_allclose(got, want, atol=0.06, rtol=0.06)
+    drop = engine.last_moe_drop_fraction
+    assert drop is not None and 0.0 <= drop <= 1.0
+
+
+def test_moe_capacity_factor_override_via_zoo_factory(mesh_tp):
+    import dataclasses as _dc
+
+    model = _tiny_vit(mlp_impl="moe", n_experts=2)
+    params, ms = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, *IMAGE_SHAPE), jnp.float32))
+    bundle = _dc.make_dataclass(
+        "B", ["model", "params", "model_state", "image_shape", "rules"])(
+        model, params, ms, IMAGE_SHAPE, resolve_rules("tp"))
+    engine = build_zoo_engine(bundle, mesh_tp, model_name="vit_moe",
+                              max_bucket=8, moe_capacity_factor=0.25)
+    assert engine.model.moe_capacity_factor == 0.25
+    engine.predict(_images(8, h=16, seed=3))
+    # a starved capacity factor must SURFACE drops, not silently truncate
+    assert engine.last_moe_drop_fraction is not None
+    # a dense model refuses the knob instead of ignoring it
+    dense = _dc.make_dataclass(
+        "D", ["model", "params", "model_state", "image_shape", "rules"])(
+        get_model("mlp"), None, None, (28, 28, 1), resolve_rules("dp"))
+    with pytest.raises(ValueError, match="moe_capacity_factor"):
+        build_zoo_engine(dense, mesh_tp, model_name="mlp",
+                         moe_capacity_factor=2.0)
+
+
+# -- sharded serving + cross-strategy restore ---------------------------------
+
+def test_cross_strategy_restore_fsdp_to_tp_bit_parity(mesh_tp, tmp_path):
+    """A checkpoint written under one strategy restores bit-identically
+    under another: the serve rules only change PLACEMENT."""
+    import dataclasses
+
+    from dist_mnist_tpu.checkpoint.manager import CheckpointManager
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.optim import adam
+    from dist_mnist_tpu.train.state import create_train_state
+
+    cfg = get_config("vit_tiny_cifar")
+    cfg = dataclasses.replace(
+        cfg, model_kwargs={"depth": 1, "dim": 16, "heads": 2,
+                           "pool": "mean"},
+        sharding_rules="fsdp")
+    model = get_model(cfg.model, **cfg.model_kwargs)
+    sample = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    state = create_train_state(model, adam(1e-3),
+                               jax.random.PRNGKey(cfg.seed), sample)
+    state = dataclasses.replace(state, step=jnp.asarray(7, jnp.int32))
+    mgr = CheckpointManager(tmp_path / "ckpt", async_save=False)
+    assert mgr.save(state)
+    mgr.wait()
+    mgr.close()
+
+    served_tp = load_for_serving(cfg, mesh_tp, checkpoint_dir=tmp_path / "ckpt",
+                                 sharding_rules="tp")
+    served_dp = load_for_serving(cfg, mesh_tp, checkpoint_dir=tmp_path / "ckpt",
+                                 sharding_rules="dp")
+    assert served_tp.restored and served_dp.restored
+    for a, b in zip(jax.tree.leaves(served_tp.params),
+                    jax.tree.leaves(served_dp.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    eng_tp = build_zoo_engine(served_tp, mesh_tp, model_name="vit_tp",
+                              max_bucket=8)
+    eng_dp = build_zoo_engine(served_dp, mesh_tp, model_name="vit_dp",
+                              max_bucket=8)
+    images = np.random.default_rng(5).integers(
+        0, 256, size=(8, 32, 32, 3), dtype=np.uint8)
+    # same VALUES, different placements: logits agree to bf16 reduction-
+    # order noise (TP partial-sums across the model axis)
+    np.testing.assert_allclose(eng_tp.predict(images),
+                               eng_dp.predict(images),
+                               atol=0.04, rtol=0.04)
+    # TP weights serve resident-sharded: strictly fewer bytes per device
+    assert eng_tp.state_bytes_per_device()["param_bytes"] < \
+        eng_dp.state_bytes_per_device()["param_bytes"]
+
+
+def test_fsdp_restore_serves_at_a_fraction_of_dense_bytes(mesh8, tmp_path):
+    """The acceptance shape: an fsdp-placed restore holds ~1/data-axis of
+    the replicated dense per-device bytes (big matmul params dominate)."""
+    fsdp = load_for_serving("mlp_mnist", mesh8, sharding_rules="fsdp")
+    dense = load_for_serving("mlp_mnist", mesh8)
+    eng_f = build_zoo_engine(fsdp, mesh8, model_name="mlp_f", max_bucket=8)
+    eng_d = build_zoo_engine(dense, mesh8, model_name="mlp_d", max_bucket=8)
+    f = eng_f.state_bytes_per_device()["param_bytes"]
+    d = eng_d.state_bytes_per_device()["param_bytes"]
+    assert f < 0.25 * d, f"fsdp {f} B/device vs dense {d} B/device"
+    images = np.random.default_rng(0).integers(
+        0, 256, size=(4, 28, 28, 1), dtype=np.uint8)
+    np.testing.assert_allclose(eng_f.predict(images), eng_d.predict(images),
+                               atol=1e-5, rtol=1e-5)
+
+
+# -- memory budget ------------------------------------------------------------
+
+class _FakeExe:
+    def __init__(self, nbytes):
+        self._n = nbytes
+
+    def memory_analysis(self):
+        import types
+
+        return types.SimpleNamespace(generated_code_size_in_bytes=self._n,
+                                     temp_size_in_bytes=0)
+
+
+def test_budget_lru_evicts_coldest_and_counts():
+    cache = CompiledModelCache()
+    cache.set_budget(1000, base_bytes=400)
+    cache.get("a", lambda: _FakeExe(300))
+    cache.get("b", lambda: _FakeExe(300))  # resident 1000 == budget: fits
+    cache.get("a", lambda: _FakeExe(300))  # touch a -> b is now coldest
+    cache.get("c", lambda: _FakeExe(300))  # must evict b, never c
+    assert cache.evictions == 1
+    stats = cache.stats()
+    assert stats["entries"] == 2
+    misses0 = stats["misses"]
+    cache.get("a", lambda: _FakeExe(300))  # still resident
+    assert cache.stats()["misses"] == misses0
+    cache.get("b", lambda: _FakeExe(300))  # evicted -> rebuilds
+    assert cache.stats()["misses"] == misses0 + 1
+
+
+def test_budget_refusals():
+    cache = CompiledModelCache()
+    with pytest.raises(ServeMemoryBudgetError, match="weights alone"):
+        cache.set_budget(300, base_bytes=400)
+    cache.set_budget(1000, base_bytes=400)
+    with pytest.raises(ServeMemoryBudgetError, match="even alone"):
+        cache.get("big", lambda: _FakeExe(700))
+    assert cache.stats()["entries"] == 0  # the unfittable entry was popped
+
+
+def test_engine_prewarm_refuses_impossible_budget(mesh8):
+    model = _tiny_vit()
+    params, ms = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, *IMAGE_SHAPE), jnp.float32))
+    engine = InferenceEngine(
+        model, params, ms, mesh8, model_name="vit_tight",
+        image_shape=IMAGE_SHAPE, rules=resolve_rules("dp"), max_bucket=8,
+        seq_grid=default_seq_grid(IMAGE_SHAPE, 4),
+        # one byte of executable headroom beyond the weights: the first
+        # compiled cell cannot fit beside them
+        memory_budget_bytes=(
+            sum(int(np.prod(p.shape)) * 4 for p in jax.tree.leaves(params))
+            + 1),
+    )
+    with pytest.raises(ServeMemoryBudgetError):
+        engine.prewarm()
+
+
+def test_weights_over_budget_refused_at_construction(mesh8):
+    model = _tiny_vit()
+    params, ms = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, *IMAGE_SHAPE), jnp.float32))
+    with pytest.raises(ServeMemoryBudgetError, match="weights alone"):
+        InferenceEngine(
+            model, params, ms, mesh8, model_name="vit_nofit",
+            image_shape=IMAGE_SHAPE, rules=resolve_rules("dp"),
+            max_bucket=8, memory_budget_bytes=16,
+        )
+
+
+# -- batcher: oversized-window split ------------------------------------------
+
+def test_batcher_splits_oversized_window_across_executions(mesh8):
+    bundle = load_for_serving("mlp_mnist", mesh8)
+    engine = InferenceEngine(
+        bundle.model, bundle.params, bundle.model_state, mesh8,
+        model_name="mlp_split", image_shape=bundle.image_shape,
+        rules=bundle.rules, max_bucket=16,
+    )
+    # max_batch 40 > max_bucket 16: the window must split, not raise
+    server = InferenceServer(engine, ServeConfig(
+        max_batch=40, max_wait_ms=25.0, queue_depth=64))
+    images = np.random.default_rng(0).integers(
+        0, 256, size=(40, 28, 28, 1), dtype=np.uint8)
+    with server:
+        futs = [server.submit(img) for img in images]
+        results = [f.result(timeout=60.0) for f in futs]
+    assert len(results) == 40
+    assert server.metrics.completed == 40
+    assert server.metrics.batch_size.snapshot()["max"] <= 16
+    # a single DIRECT predict beyond the ceiling still raises
+    with pytest.raises(ValueError, match="max_bucket"):
+        engine.bucket_for(17)
+
+
+def test_async_prewarm_warms_grid_in_background_and_joins(mesh8):
+    import time
+
+    model = _tiny_vit()
+    params, ms = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, *IMAGE_SHAPE), jnp.float32))
+    engine = InferenceEngine(
+        model, params, ms, mesh8, model_name="vit_async",
+        image_shape=IMAGE_SHAPE, rules=resolve_rules("dp"), max_bucket=8,
+        seq_grid=default_seq_grid(IMAGE_SHAPE, 4),
+    )
+    server = InferenceServer(engine, ServeConfig(
+        max_batch=8, max_wait_ms=1.0, queue_depth=32, prewarm_async=True))
+    with server:
+        # serving is live immediately; a request may pay its own compile
+        fut = server.submit(_images(1, h=16)[0])
+        assert fut.result(timeout=60.0).logits.shape == (10,)
+        deadline = time.monotonic() + 60.0
+        want = len(engine.buckets()) * (1 + len(engine.seq_grid.heights))
+        while engine.cache.stats()["entries"] < want:
+            assert time.monotonic() < deadline, "background prewarm stalled"
+            time.sleep(0.05)
+    # close() joined the ZooPrewarm thread (conftest's leak check would
+    # fail this test otherwise); no refusal was recorded
+    assert "prewarm_error" not in server.stats()
+
+
+# -- hot swap on a sharded zoo replica ----------------------------------------
+
+def test_roll_weights_rewarm_retouches_grid_without_recompiling(mesh_tp):
+    from dist_mnist_tpu.serve import InProcessReplica, Router, RouterConfig
+
+    model = _tiny_vit()
+    params, ms = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, *IMAGE_SHAPE), jnp.float32))
+    cache = CompiledModelCache()
+
+    def make_server():
+        engine = InferenceEngine(
+            model, params, ms, mesh_tp, model_name="vit_roll",
+            image_shape=IMAGE_SHAPE, rules=resolve_rules("tp"),
+            max_bucket=4, cache=cache,
+            seq_grid=default_seq_grid(IMAGE_SHAPE, 4),
+        )
+        return InferenceServer(engine, ServeConfig(
+            max_batch=4, max_wait_ms=1.0, queue_depth=32)).start()
+
+    def load_weights(step):
+        return jax.tree.map(lambda p: p + 1.0, params), ms
+
+    replica = InProcessReplica(0, make_server,
+                               load_weights=load_weights).start()
+    router = Router([replica], RouterConfig(health_interval_s=0.05)).start()
+    try:
+        misses_warm = cache.stats()["misses"]
+        res = router.roll_weights(9)
+        assert not res["failed"]
+        eng = replica.server.engine
+        assert eng.weights_version == 9
+        # the post-swap rewarm walked the whole 2-D grid as memory hits
+        assert cache.stats()["misses"] == misses_warm
+        # short and native requests both serve on the NEW weights
+        fut = router.submit(_images(1, h=8, seed=4)[0])
+        assert fut.result(timeout=30.0).logits.shape == (10,)
+    finally:
+        router.close()
+        replica.close()
