@@ -628,6 +628,141 @@ def bench_serve_longctx(n_requests: int, concurrency: int) -> int:
     return 0
 
 
+def bench_serve_quant(n_requests: int, concurrency: int) -> int:
+    """Quantized serving, proved not just logged: the SAME deterministic
+    loadgen stream through a float engine and an int8 weight-only engine
+    (ops/quant.py) side by side. Asserts, per ISSUE 14's ladder:
+    resident weight bytes <= 0.30x float, top-1 agreement >= 0.99 on the
+    stream's image pool, quantized p99 <= 1.10x float p99, and ZERO
+    hot-path recompiles after prewarm on both engines. Reports
+    `quant_p99_ms` plus a second anchored record,
+    `quant_resident_bytes_ratio`."""
+    import jax
+    import numpy as np
+
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.serve import (
+        InferenceServer,
+        ServeConfig,
+        build_zoo_engine,
+        load_for_serving,
+        run_loadgen,
+    )
+    from dist_mnist_tpu.serve.loadgen import make_images
+
+    metric = "quant_p99_ms"
+    mesh = make_mesh(MeshSpec(data=-1))
+    # one float load + one int8 load of the SAME deterministic init: the
+    # quant bundle's params are the float bundle's, converted (the bench
+    # needs no training run to exist; agreement is measured between the
+    # two engines, not against labels)
+    bundle_f = load_for_serving("mlp_mnist", mesh)
+    bundle_q = load_for_serving("mlp_mnist", mesh, quant="int8")
+    runs = {}
+    engines = {}
+    for tag, bundle in (("float", bundle_f), ("int8", bundle_q)):
+        engine = build_zoo_engine(bundle, mesh, model_name="mlp",
+                                  max_bucket=64)
+        engines[tag] = engine
+        server = InferenceServer(engine, ServeConfig(
+            max_batch=64, max_wait_ms=2.0, queue_depth=4 * concurrency,
+        ))
+        with server:
+            # warmup AFTER prewarm: first-dispatch cost off the timed run
+            run_loadgen(server, n_requests=concurrency,
+                        concurrency=concurrency,
+                        image_shape=bundle.image_shape, seed=1)
+            misses0 = engine.cache.misses
+            summary = run_loadgen(server, n_requests=n_requests,
+                                  concurrency=concurrency,
+                                  image_shape=bundle.image_shape, seed=0)
+        summary["recompiles_during_traffic"] = \
+            engine.cache.misses - misses0
+        runs[tag] = summary
+    for tag, summary in runs.items():
+        if summary["recompiles_during_traffic"]:
+            emit_error(metric,
+                       f"{summary['recompiles_during_traffic']} hot-path "
+                       f"recompile(s) on the {tag} engine after prewarm")
+            return 1
+    # resident weight bytes under the engines' ACTUAL placements — the
+    # number the serve memory budget rations
+    bytes_f = engines["float"].state_bytes_per_device()
+    bytes_q = engines["int8"].state_bytes_per_device()
+    ratio = bytes_q["param_bytes"] / max(bytes_f["param_bytes"], 1)
+    if ratio > 0.30:
+        emit_error(metric,
+                   f"quantized resident weight bytes {ratio:.3f}x float "
+                   "(gate: <= 0.30x)")
+        return 1
+    # top-1 agreement over the timed stream's image pool (seed=0 — the
+    # exact images the loadgen cycled through), batch-bucket sized chunks
+    # so no new executable compiles here
+    pool = make_images(bundle_f.image_shape, seed=0)
+    flips = 0
+    for i in range(0, len(pool), 64):
+        lf = engines["float"].predict(pool[i:i + 64])
+        lq = engines["int8"].predict(pool[i:i + 64])
+        flips += int(np.sum(np.argmax(lf, -1) != np.argmax(lq, -1)))
+    agreement = 1.0 - flips / len(pool)
+    if agreement < 0.99:
+        emit_error(metric,
+                   f"top-1 agreement {agreement:.4f} vs the float engine "
+                   "(gate: >= 0.99)")
+        return 1
+    p99_f, p99_q = runs["float"]["p99_ms"], runs["int8"]["p99_ms"]
+    if p99_q > 1.10 * p99_f:
+        emit_error(metric,
+                   f"quantized p99 {p99_q:.2f} ms > 1.10x float p99 "
+                   f"{p99_f:.2f} ms")
+        return 1
+    report = bundle_q.quant_report
+    # the resident-bytes ratio is its own anchored record: deterministic
+    # (pure dtype arithmetic), so the regression gate pins it tightly
+    emit({
+        "metric": "quant_resident_bytes_ratio",
+        "value": round(ratio, 4),
+        "unit": "x_float",
+        "vs_baseline": 0.0,
+        "extra": {
+            "float_param_bytes": bytes_f["param_bytes"],
+            "int8_param_bytes": bytes_q["param_bytes"],
+            **_anchor_fields("quant_resident_bytes_ratio", ratio),
+        },
+    })
+    emit({
+        "metric": metric,
+        "value": round(p99_q, 2),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "extra": {
+            "chips": jax.device_count(),
+            "float_p99_ms": round(p99_f, 2),
+            "p99_ratio_vs_float": round(p99_q / max(p99_f, 1e-9), 3),
+            "p50_ms": round(runs["int8"]["p50_ms"], 2),
+            "mean_ms": round(runs["int8"]["mean_ms"], 2),
+            "float_mean_ms": round(runs["float"]["mean_ms"], 2),
+            "resident_bytes_ratio": round(ratio, 4),
+            "top1_agreement": round(agreement, 4),
+            "top1_flips": flips,
+            "pool_size": len(pool),
+            "quant_error_max": report["max_abs_err"],
+            "quant_rel_err_max": report["max_rel_err"],
+            "quant_leaves": report["n_quantized"],
+            "per_leaf_rel_err": {
+                k: round(v["rel_err"], 6)
+                for k, v in report["leaves"].items()},
+            "recompiles_during_traffic": 0,
+            "n_requests": n_requests,
+            "concurrency": concurrency,
+            "ok": runs["int8"]["ok"],
+            "cache": runs["int8"]["cache"],
+            **_anchor_fields(metric, p99_q),
+        },
+    })
+    return 0
+
+
 def bench_serve_fleet(n_requests: int, concurrency: int, *,
                       replicas: int = 3) -> int:
     """Fleet-serving robustness: two-class traffic through a 3-replica
@@ -2196,6 +2331,13 @@ if __name__ == "__main__":
                          "(fleet_p99_latency_sensitive_ms)")
     ap.add_argument("--fleet-replicas", type=int, default=3,
                     help="fleet size in --serve --fleet mode")
+    ap.add_argument("--quant", action="store_true",
+                    help="with --serve: quantized-serving mode — the same "
+                         "loadgen stream through a float and an int8 "
+                         "weight-only engine side by side; asserts the "
+                         "resident-bytes ratio, top-1 agreement, p99 "
+                         "parity, and zero hot-path recompiles "
+                         "(quant_p99_ms)")
     ap.add_argument("--longctx", action="store_true",
                     help="with --serve: long-context mode — variable-height "
                          "traffic through the model-zoo (batch, seq-bucket) "
@@ -2270,6 +2412,7 @@ if __name__ == "__main__":
     metric = ("fleet_p99_latency_sensitive_ms"
               if args.serve and args.fleet
               else "longctx_p99_ms" if args.serve and args.longctx
+              else "quant_p99_ms" if args.serve and args.quant
               else "serve_p99_latency_ms" if args.serve
               else "input_stall_ms_per_step" if args.input_mode
               else "fsdp_per_device_state_bytes" if args.memory_mode
@@ -2301,6 +2444,8 @@ if __name__ == "__main__":
                  if args.serve and args.fleet
                  else bench_serve_longctx(args.requests, args.concurrency)
                  if args.serve and args.longctx
+                 else bench_serve_quant(args.requests, args.concurrency)
+                 if args.serve and args.quant
                  else bench_serve(args.requests, args.concurrency)
                  if args.serve
                  else bench_input(args.steps, depth=args.prefetch_depth)

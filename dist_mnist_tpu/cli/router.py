@@ -68,6 +68,12 @@ flags.DEFINE_float("serve_memory_budget_mb", 0,
 flags.DEFINE_string("serve_rules", None,
                     "serve-time sharding strategy override (cross-strategy "
                     "restore; see docs/SERVING.md)")
+flags.DEFINE_string("quant", None,
+                    'weight-only quantized serving ("int8"), forwarded to '
+                    "every replica: ~4x smaller resident weights per "
+                    "replica engine, so more replicas fit one host's "
+                    "budget; hot-swap rolls re-quantize on the fly "
+                    "(docs/SERVING.md)")
 flags.DEFINE_string("fault_plan", None,
                     "faults/plan.py FaultPlan JSON (inline or path); "
                     "serve_replica_kill / serve_replica_stall target "
@@ -154,6 +160,8 @@ def _spawn_replicas(n: int):
                 f"--serve_memory_budget_mb={FLAGS.serve_memory_budget_mb}")
         if FLAGS.serve_rules:
             cmd.append(f"--serve_rules={FLAGS.serve_rules}")
+        if FLAGS.quant:
+            cmd.append(f"--quant={FLAGS.quant}")
         if FLAGS.fault_plan:
             cmd.append(f"--fault_plan={FLAGS.fault_plan}")
         if FLAGS.mesh:
@@ -216,7 +224,7 @@ def _build_inprocess_replicas(n: int):
     mesh = make_mesh(spec)
     bundle = load_for_serving(
         cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step,
-        sharding_rules=FLAGS.serve_rules)
+        sharding_rules=FLAGS.serve_rules, quant=FLAGS.quant or None)
     store = None
     if FLAGS.compile_cache_dir:
         from pathlib import Path
@@ -254,9 +262,13 @@ def _build_inprocess_replicas(n: int):
         return make_server
 
     def load_weights(step: int):
+        # quant rides the reload too: `roll_weights` hands each replica an
+        # already-quantized tree (the engine would re-quantize a float one
+        # anyway — this just pays the conversion once per roll, not per
+        # replica)
         new = load_for_serving(
             cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=step,
-            sharding_rules=FLAGS.serve_rules)
+            sharding_rules=FLAGS.serve_rules, quant=FLAGS.quant or None)
         if not new.restored:
             raise FileNotFoundError(f"no committed checkpoint at step {step}")
         return new.params, new.model_state
@@ -377,6 +389,8 @@ def main(argv):
         )
         summary["replicas"] = FLAGS.replicas
         summary["inprocess"] = FLAGS.inprocess
+        if FLAGS.quant:
+            summary["quant"] = FLAGS.quant
         summary["serving_step"] = router.serving_step
         if watcher is not None:
             summary["watcher"] = {"polls": watcher.polls,

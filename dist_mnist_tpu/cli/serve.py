@@ -73,6 +73,14 @@ flags.DEFINE_string("serve_rules", None,
                     "fsdp/fsdp_tp): restore a checkpoint trained under one "
                     "strategy directly into another's layout (cross-"
                     "strategy restore; see docs/SERVING.md)")
+flags.DEFINE_string("quant", None,
+                    'weight-only quantized serving: "int8" converts '
+                    "matmul/conv kernels to (int8, f32 per-channel scale) "
+                    "at load time — ~4x smaller resident weights under "
+                    "--serve_memory_budget_mb; biases/norms/embeddings/"
+                    "router gates stay float. Per-leaf quant error lands "
+                    "on /metrics as serve/quant_error*; unset = full-width "
+                    "float serving (docs/SERVING.md)")
 flags.DEFINE_string("compile_cache_dir", None,
                     "warm-start cache directory (compilecache/): prewarm "
                     "deserializes the buckets a previous server process "
@@ -134,13 +142,18 @@ def _serve_forever(server, exporter, cfg, mesh) -> dict:
                 raise TimeoutError("pipeline did not quiesce for swap")
             new = load_for_serving(
                 cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=step,
-                sharding_rules=FLAGS.serve_rules)
+                sharding_rules=FLAGS.serve_rules,
+                quant=FLAGS.quant or None)
             if not new.restored:
                 raise FileNotFoundError(
                     f"no committed checkpoint at step {step}")
             server.engine.swap_weights(new.params, new.model_state,
                                        version=step)
-            return {"swapped": True, "step": step}
+            if new.quant_report:
+                # refresh the /metrics quant-error surface for the NEW
+                # weights the replica now serves
+                server.metrics.record_quant_report(new.quant_report)
+            return {"swapped": True, "step": step, "quant": new.quant}
 
     exporter.predict_fn = predict_fn
     exporter.swap_fn = swap_fn
@@ -231,7 +244,7 @@ def main(argv):
 
     bundle = load_for_serving(
         cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step,
-        sharding_rules=FLAGS.serve_rules,
+        sharding_rules=FLAGS.serve_rules, quant=FLAGS.quant or None,
     )
     store = None
     if FLAGS.compile_cache_dir:
@@ -300,6 +313,11 @@ def main(argv):
     summary["restored"] = bundle.restored
     summary["serve_state_bytes_per_device"] = \
         zoo_engine.state_bytes_per_device()
+    if bundle.quant:
+        summary["quant"] = bundle.quant
+        summary["quant_error_max"] = bundle.quant_report["max_abs_err"]
+        summary["quant_rel_err_max"] = bundle.quant_report["max_rel_err"]
+        summary["quant_leaves"] = bundle.quant_report["n_quantized"]
     if zoo_engine.seq_grid is not None:
         summary["seq_buckets"] = list(zoo_engine.seq_grid.heights)
         summary["seq_bucket_counts"] = {

@@ -242,13 +242,16 @@ def build_optimizer(cfg):
 
 
 def compile_cache_key_fields(cfg, mesh, *, scan_chunk=0,
-                             input_pipeline="python"):
+                             input_pipeline="python", quant="none"):
     """Everything that changes the compiled step program, as a flat dict —
     the ExecutableStore key is `cache_key({"kind": ..., **fields})`. The
     overlap knobs are in here so a cached serial executable can never be
     served to an overlapped run (or vice versa): the two lower to different
-    HLO even though they are value-identical."""
-    return {
+    HLO even though they are value-identical. `quant` likewise: an int8
+    weight-only program takes (int8, scale) weight arguments, so it can
+    never satisfy a float key (or vice versa); "none" keeps the field OUT
+    of the payload entirely — every pre-quant disk key stays warm."""
+    fields = {
         "config": cfg.name,
         "model": cfg.model,
         "model_kwargs": cfg.model_kwargs,
@@ -269,6 +272,9 @@ def compile_cache_key_fields(cfg, mesh, *, scan_chunk=0,
         "input_pipeline": input_pipeline,
         "prng": cfg.prng_impl,
     }
+    if quant and quant != "none":
+        fields["quant"] = quant
+    return fields
 
 
 def run_config(cfg, **kwargs):

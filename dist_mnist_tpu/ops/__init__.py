@@ -10,6 +10,6 @@ shaped, and bfloat16-friendly so XLA can tile onto the MXU.
 fallbacks.
 """
 
-from dist_mnist_tpu.ops import nn, losses, metrics
+from dist_mnist_tpu.ops import quant, nn, losses, metrics
 
-__all__ = ["nn", "losses", "metrics"]
+__all__ = ["quant", "nn", "losses", "metrics"]

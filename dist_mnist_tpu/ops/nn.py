@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dist_mnist_tpu.ops.quant import QuantizedArray, dequantize, q_dot
+
 Params = dict
 
 
@@ -60,7 +62,12 @@ def init_dense(key, in_dim: int, out_dim: int, *, init=fan_in_trunc_normal) -> P
 
 
 def dense(p: Params, x: jax.Array) -> jax.Array:
-    return x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
+    w = p["w"]
+    if isinstance(w, QuantizedArray):
+        # weight-only int8 serve path: dequant fuses into the matmul's
+        # operand load, int8 is what HBM holds (ops/quant.py)
+        return q_dot(x, w) + p["b"].astype(x.dtype)
+    return x @ w.astype(x.dtype) + p["b"].astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -77,9 +84,12 @@ def init_conv(
 def conv2d(
     p: Params, x: jax.Array, *, stride: int = 1, padding: str = "SAME"
 ) -> jax.Array:
+    w = p["w"]
+    w = (dequantize(w, x.dtype) if isinstance(w, QuantizedArray)
+         else w.astype(x.dtype))
     y = lax.conv_general_dilated(
         x,
-        p["w"].astype(x.dtype),
+        w,
         window_strides=(stride, stride),
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),

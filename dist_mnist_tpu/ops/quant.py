@@ -1,0 +1,259 @@
+"""Weight-only int8 quantization for serving: per-channel symmetric scales.
+
+The serve-side memory budget (serve/engine.py `CompiledModelCache`) rations
+resident bytes; a bf16/f32 checkpoint spends 2-4x more of that budget than
+inference accuracy needs. This module provides the standard weight-only
+answer: matmul/conv kernels live in HBM as int8 with float32 per-channel
+scales, and the dequantize (`q * scale`) is emitted INSIDE the traced
+matmul so XLA fuses it into the operand load — activations, biases, norms,
+embeddings, and the MoE router gate stay float.
+
+Representation: `QuantizedArray`, a registered pytree-with-keys node whose
+children are `(q: int8, scale: float32)` and whose aux data is the quant
+mode. Being a pytree node (not an opaque object) is the load-bearing
+choice: sharding trees, `jit` in_shardings, `device_put`, `lax.scan` over
+stacked block params, `vmap` over expert stacks, shard_map pytree-prefix
+specs, per-device byte accounting, and the engine's hot-swap shape checks
+all traverse it with zero special cases.
+
+Scale layout: the amax reduction runs over the CONTRACTION (second-to-
+minor) axis only, keepdims — so a 2-D kernel [D, H] gets scales [1, H]
+(classic per-output-channel), while stacked leaves keep their leading
+dims: scan-stacked ViT blocks [L, D, 3D] -> [L, 1, 3D], MoE expert stacks
+[E, D, H] -> [E, 1, H]. Leading dims surviving in the scale is what lets
+`lax.scan`/`vmap` slice a QuantizedArray exactly like the float leaf it
+replaced. Leaves with a zero-amax channel fall back to ONE shared
+per-tensor scale (broadcast to the same keepdims shape so the slicing
+contract holds); `mode` records which rule applied.
+
+Hot-path discipline: everything here is jit-traceable except
+`error_report` (one batched load-time `device_get`) and the degenerate-
+scale check in `quantize` (a load-time scalar `bool`). This file is in
+scripts/check_host_sync.py's lint scope.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: smallest representable scale — a zero-amax channel quantizes to q == 0
+#: with this floor instead of dividing by zero
+_EPS = 1e-12
+
+#: int8 symmetric range is [-127, 127] (the -128 slot is unused so the
+#: representable grid is symmetric around zero)
+_QMAX = 127.0
+
+#: param leaf names the default rule quantizes: dense/conv/attention
+#: kernels ("w") and the MoE expert FFN stacks ("w1"/"w2"). Everything
+#: else — biases, norm scale/bias, position/cls embeddings, and the MoE
+#: router "gate" (router precision drives top-1 agreement) — stays float.
+QUANT_LEAF_NAMES = ("w", "w1", "w2")
+
+
+@jax.tree_util.register_pytree_with_keys_class
+class QuantizedArray:
+    """int8 weights + float32 per-channel scales, as one pytree node.
+
+    `mode` is "channel" (per-output-channel scales) or "tensor" (one
+    shared scale, broadcast — the degenerate-leaf fallback); it is aux
+    data, so two QuantizedArrays with different modes are different
+    pytree structures and can never silently share a compiled program.
+    """
+
+    __slots__ = ("q", "scale", "mode")
+
+    def __init__(self, q, scale, mode: str = "channel"):
+        self.q = q
+        self.scale = scale
+        self.mode = mode
+
+    # --- array-protocol surface so shape checks / byte accounting work ---
+
+    @property
+    def shape(self):
+        return jnp.shape(self.q)
+
+    @property
+    def ndim(self):
+        return len(jnp.shape(self.q))
+
+    @property
+    def dtype(self):
+        # the STORAGE dtype — what HBM holds per element
+        return jnp.asarray(self.q).dtype if not hasattr(self.q, "dtype") \
+            else self.q.dtype
+
+    def __repr__(self):
+        return (f"QuantizedArray(shape={tuple(self.shape)}, "
+                f"scale={tuple(jnp.shape(self.scale))}, mode={self.mode!r})")
+
+    # --- pytree protocol ---
+
+    def tree_flatten_with_keys(self):
+        return (
+            (jax.tree_util.GetAttrKey("q"), self.q),
+            (jax.tree_util.GetAttrKey("scale"), self.scale),
+        ), self.mode
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scale = children
+        return cls(q, scale, aux)
+
+
+def quantize(w) -> QuantizedArray:
+    """Symmetric int8 quantization of a 2-D+ float array.
+
+    Per-channel scales over the contraction axis (see module docstring for
+    the stacked-leaf layout); per-tensor fallback when any channel's amax
+    is exactly zero. Runs eagerly at load time: on an already-sharded
+    restored leaf the elementwise ops preserve the NamedSharding, so a
+    TP/fsdp layout survives quantization."""
+    w = jnp.asarray(w)
+    if w.ndim < 2:
+        raise ValueError(
+            f"quantize() wants a 2-D+ kernel, got shape {w.shape} — 1-D "
+            "leaves (biases, norms) should stay float (default_leaf_rule)")
+    amax = jnp.max(jnp.abs(w), axis=w.ndim - 2, keepdims=True)
+    mode = "channel"
+    # load-time scalar pull, never traced: `quantize` runs once per leaf at
+    # checkpoint-load/hot-swap, outside the request hot path
+    if not bool(jnp.all(amax > 0.0)):
+        t_amax = jnp.max(jnp.abs(w))
+        # broadcast the single tensor scale to the per-channel keepdims
+        # shape: leading (stack) dims keep their extent, so scan/vmap
+        # slicing stays identical to the per-channel layout
+        amax = jnp.broadcast_to(t_amax, amax.shape)
+        mode = "tensor"
+    scale = (jnp.maximum(amax, _EPS) / _QMAX).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -_QMAX, _QMAX).astype(jnp.int8)
+    return QuantizedArray(q, scale, mode)
+
+
+def dequantize(qa: QuantizedArray, dtype=None):
+    """`q * scale` back to float; `dtype` is the compute dtype (bf16 under
+    the serve cast policy). Traced inside the consuming matmul so XLA
+    fuses it — the weights never materialize at full width in HBM."""
+    dtype = jnp.float32 if dtype is None else dtype
+    return qa.q.astype(dtype) * qa.scale.astype(dtype)
+
+
+def materialize(w, dtype=None):
+    """Uniform access for code paths that may see either representation:
+    a plain array passes through UNTOUCHED (bit-identical float baseline);
+    a QuantizedArray dequantizes into `dtype`."""
+    if isinstance(w, QuantizedArray):
+        return dequantize(w, dtype)
+    return w
+
+
+def q_dot(x, w: QuantizedArray):
+    """x @ dequant(w) with the dequant fused into the matmul's operand
+    load; accumulates in x's compute dtype like the float path."""
+    return x @ dequantize(w, x.dtype)
+
+
+def q_einsum(spec: str, x, w: QuantizedArray):
+    """einsum twin of `q_dot` for non-matmul contractions."""
+    return jnp.einsum(spec, x, dequantize(w, x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# tree-level transform
+
+
+def _seg(key) -> str:
+    """One path component as text (DictKey/GetAttrKey/SequenceKey)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(key, attr):
+            return str(getattr(key, attr))
+    return str(key)
+
+
+def path_str(path) -> str:
+    return "/".join(_seg(k) for k in path)
+
+
+def default_leaf_rule(path, leaf) -> bool:
+    """Quantize matmul/conv kernels; keep everything else float.
+
+    The rule is name + shape + dtype: the leaf's last path segment must be
+    a kernel name (`QUANT_LEAF_NAMES`), the leaf 2-D+ (1-D biases/norms
+    excluded even if misnamed), and floating (an already-int leaf is left
+    alone). Shared verbatim by dense/ViT/MoE — ViT's pos/cls/LN and the
+    MoE router gate fall out by name."""
+    if not path:
+        return False
+    name = _seg(path[-1])
+    shape = jnp.shape(leaf) if hasattr(leaf, "shape") else ()
+    dtype = getattr(leaf, "dtype", None)
+    return (name in QUANT_LEAF_NAMES
+            and len(shape) >= 2
+            and dtype is not None
+            and jnp.issubdtype(dtype, jnp.floating))
+
+
+def quantize_tree(tree, rule=default_leaf_rule):
+    """Apply `quantize` to every leaf the rule selects; structure-preserving
+    otherwise. Idempotent: QuantizedArray nodes pass through."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: isinstance(x, QuantizedArray))
+    out = []
+    for path, leaf in flat:
+        if not isinstance(leaf, QuantizedArray) and rule(path, leaf):
+            leaf = quantize(leaf)
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def is_quantized(tree) -> bool:
+    """True when any leaf of `tree` is a QuantizedArray."""
+    flat = jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda x: isinstance(x, QuantizedArray))
+    return any(isinstance(x, QuantizedArray) for x in flat)
+
+
+def error_report(float_tree, quant_tree) -> dict:
+    """Per-leaf quantization error of `quant_tree` against the float
+    original: {"leaves": {path: {max_abs_err, rel_err, mode}},
+    "max_abs_err", "max_rel_err", "n_quantized"}.
+
+    rel_err is max|w - deq(q)| / max|w| per leaf — scale-free, so one
+    tolerance covers kernels of any magnitude. All per-leaf maxima are
+    stacked device-side and pulled in ONE batched transfer."""
+    f_flat = {path_str(p): leaf for p, leaf
+              in jax.tree_util.tree_flatten_with_path(float_tree)[0]}
+    q_flat, _ = jax.tree_util.tree_flatten_with_path(
+        quant_tree, is_leaf=lambda x: isinstance(x, QuantizedArray))
+    names, modes, stats = [], [], []
+    for path, leaf in q_flat:
+        if not isinstance(leaf, QuantizedArray):
+            continue
+        name = path_str(path)
+        w = f_flat.get(name)
+        if w is None:
+            continue
+        wf = jnp.asarray(w, jnp.float32)
+        err = jnp.max(jnp.abs(wf - dequantize(leaf, jnp.float32)))
+        ref = jnp.max(jnp.abs(wf))
+        names.append(name)
+        modes.append(leaf.mode)
+        stats.append(jnp.stack([err, ref]))
+    report = {"leaves": {}, "max_abs_err": 0.0, "max_rel_err": 0.0,
+              "n_quantized": len(names)}
+    if not names:
+        return report
+    # host-sync-ok: ONE batched pull of all per-leaf maxima, at load time
+    vals = np.asarray(jax.device_get(jnp.stack(stats))).tolist()
+    for name, mode, (err, ref) in zip(names, modes, vals):
+        rel = err / max(ref, _EPS)
+        report["leaves"][name] = {
+            "max_abs_err": err, "rel_err": rel, "mode": mode,
+        }
+        report["max_abs_err"] = max(report["max_abs_err"], err)
+        report["max_rel_err"] = max(report["max_rel_err"], rel)
+    return report

@@ -58,6 +58,7 @@ from dist_mnist_tpu.cluster.mesh import (
     compat_shard_map,
 )
 from dist_mnist_tpu.ops.nn import fan_in_trunc_normal
+from dist_mnist_tpu.ops.quant import materialize
 
 
 def init_moe(key, dim: int, hidden: int, n_experts: int):
@@ -129,8 +130,10 @@ def _route(gate_w, x, n_experts: int, capacity: int, top_k: int = 1):
 
 
 def _expert_ffn(w1, b1, w2, b2, tokens):
-    h = jax.nn.relu(tokens @ w1 + b1)
-    return h @ w2 + b2
+    # materialize() is identity on float weights (bit-identical baseline);
+    # int8-served expert stacks dequantize into the matmul (ops/quant.py)
+    h = jax.nn.relu(tokens @ materialize(w1, tokens.dtype) + b1)
+    return h @ materialize(w2, tokens.dtype) + b2
 
 
 def moe_ffn_dense(params, x, capacity_factor: float = 1.25, top_k: int = 1):
@@ -178,8 +181,10 @@ def moe_ffn_inner(params, x, axis_name: str = MODEL_AXIS,
     # routed to ITS expert, concatenated in rank order -> [1, E*C, D]
     recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=1,
                           tiled=True)
-    w1, b1, w2, b2 = (jnp.squeeze(params[k], 0) for k in
-                      ("w1", "b1", "w2", "b2"))
+    # tree.map so a QuantizedArray expert stack unstacks its q AND scale
+    # (a plain array is a single leaf — identical to a direct squeeze)
+    w1, b1, w2, b2 = (jax.tree.map(lambda a: jnp.squeeze(a, 0), params[k])
+                      for k in ("w1", "b1", "w2", "b2"))
     out_tok = _expert_ffn(w1, b1, w2, b2, recv[0])  # [E*C, D]
     # reverse all_to_all: chunk s of out_tok goes back to rank s; what
     # arrives from rank e is expert e's outputs for OUR tokens -> [E, C, D]

@@ -40,7 +40,7 @@ from dist_mnist_tpu.serve.errors import (
     ShedError,
     classify_failure,
 )
-from dist_mnist_tpu.serve.loader import load_for_serving
+from dist_mnist_tpu.serve.loader import load_for_serving, quantize_for_serving
 from dist_mnist_tpu.serve.loadgen import (
     run_fleet_loadgen,
     run_loadgen,
@@ -92,6 +92,7 @@ __all__ = [
     "default_seq_grid",
     "load_for_serving",
     "parse_seq_buckets",
+    "quantize_for_serving",
     "run_fleet_loadgen",
     "run_loadgen",
     "run_longctx_loadgen",

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dist_mnist_tpu.cluster.mesh import DATA_AXIS
 from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.ops.quant import is_quantized, quantize_tree
 from dist_mnist_tpu.parallel.sharding import DP_RULES, ShardingRules, tree_sharding
 from dist_mnist_tpu.utils.timing import stopclock
 
@@ -111,6 +112,19 @@ class CompiledModelCache:
                     f"served weights alone ({base_bytes} B/device) exceed "
                     f"the serve memory budget ({budget_bytes} B)")
             self.budget_bytes = budget_bytes
+            self.base_bytes = base_bytes
+
+    def set_base_bytes(self, base_bytes: int) -> None:
+        """Update the weights floor WITHOUT touching the budget arming —
+        budgetless engines still report the weights-vs-executables split
+        (stats/metrics), and a quantized engine's floor is what lets a
+        budget that refused the bf16 grid admit the int8 one."""
+        with self._lock:
+            if (self.budget_bytes is not None
+                    and base_bytes > self.budget_bytes):
+                raise ServeMemoryBudgetError(
+                    f"served weights alone ({base_bytes} B/device) exceed "
+                    f"the serve memory budget ({self.budget_bytes} B)")
             self.base_bytes = base_bytes
 
     def resident_bytes(self) -> int:
@@ -209,6 +223,12 @@ class CompiledModelCache:
                 "resident_bytes": self.base_bytes + sum(
                     v.get("nbytes", 0) for k, v in self.per_key.items()
                     if k in self._cache),
+                # the split the budget is actually spending on: weights
+                # floor (non-evictable) vs executables (the LRU tier)
+                "resident_bytes_weights": self.base_bytes,
+                "resident_bytes_executables": sum(
+                    v.get("nbytes", 0) for k, v in self.per_key.items()
+                    if k in self._cache),
                 "budget_bytes": self.budget_bytes,
                 "compile_secs": self.times.get("compile", 0.0),
                 "execute_secs": self.times.get("execute", 0.0),
@@ -246,11 +266,32 @@ class InferenceEngine:
         cache: CompiledModelCache | None = None,
         seq_grid=None,
         memory_budget_bytes: int | None = None,
+        quant: str | None = None,
+        quant_report: dict | None = None,
     ):
         self.model = model
         self.mesh = mesh
         self.model_name = model_name
         self.image_shape = tuple(image_shape)
+        # weight-only quantized serving (ops/quant.py): `quant="int8"`
+        # converts float kernels to (int8, f32 scale) pytree nodes HERE
+        # (idempotent — a loader-quantized tree passes through), and an
+        # already-quantized tree auto-tags the engine so cache keys and
+        # byte accounting can never disagree with the weights actually
+        # served. Eager quantization of restored sharded leaves preserves
+        # their NamedShardings, so a TP/fsdp restore serves quantized
+        # under the same placements.
+        if quant is None and is_quantized(params):
+            quant = "int8"
+        if quant is not None and quant != "int8":
+            raise ValueError(f"unsupported quant mode {quant!r} "
+                             "(supported: 'int8')")
+        if quant and not is_quantized(params):
+            params = quantize_tree(params)
+        self.quant = quant
+        #: per-leaf quantization-error report (ops/quant.error_report) when
+        #: the loader produced one; surfaced on /metrics by the server
+        self.quant_report = quant_report
         # `cache` lets N same-model replicas share one CompiledModelCache:
         # executables take (params, model_state, x) as runtime arguments, so
         # a program compiled by replica 0 serves replica 1's weights too —
@@ -304,6 +345,11 @@ class InferenceEngine:
             self.cache.set_budget(
                 memory_budget_bytes,
                 base_bytes=self.state_bytes_per_device()["total_bytes"])
+        else:
+            # budgetless engines still record the weights floor so the
+            # stats/metrics weights-vs-executables split is live
+            self.cache.set_base_bytes(
+                self.state_bytes_per_device()["total_bytes"])
 
     @staticmethod
     def _live_or_rule_sharding(tree, mesh, rules):
@@ -354,7 +400,14 @@ class InferenceEngine:
         A batch already executing keeps its references to the old arrays
         (the arguments were captured at call time); the swap is only
         *observable* from the next `predict`.
+
+        A quantized engine RE-QUANTIZES an incoming float tree on the fly
+        (the rollout path hands us full-width checkpoints): the cached
+        int8 programs take (int8, scale) arguments, so quantizing before
+        the shape checks is what keeps hot-swap compile-free.
         """
+        if self.quant and not is_quantized(params):
+            params = quantize_tree(params)
 
         def _check(old, new):
             if tuple(old.shape) != tuple(jnp.shape(new)):
@@ -432,8 +485,14 @@ class InferenceEngine:
     def _key(self, bucket: int, height: int | None = None):
         h = self.image_shape[0] if height is None else height
         mesh_key = tuple(sorted(self.mesh.shape.items()))
+        # quant mode rides the dtype component: an int8 engine's programs
+        # take (int8, scale) weight arguments, so they can NEVER be keyed
+        # identically to a float engine's (shared fleet caches included);
+        # the float tag is byte-identical to the historical one
+        dtype_key = ("uint8->float32" if not self.quant
+                     else f"uint8->float32/w{self.quant}")
         return (self.model_name, (bucket, h, *self.image_shape[1:]),
-                mesh_key, "uint8->float32",
+                mesh_key, dtype_key,
                 "dense" if height is None else "masked")
 
     def _compile(self, bucket: int, height: int | None = None):
@@ -500,6 +559,12 @@ class InferenceEngine:
             payload["variant"] = "masked"
         if self._moe:
             payload["moe_outputs"] = "drop_fraction"
+        # conditional for the same reason: float payloads stay byte-for-
+        # byte what they were, while an int8 engine's store keys diverge —
+        # a warm-start store can never hand an int8 program to a float
+        # engine (or vice versa)
+        if self.quant:
+            payload["quant"] = self.quant
         return cache_key(payload)
 
     def compiled_for(self, bucket: int, height: int | None = None):
@@ -592,9 +657,12 @@ class InferenceEngine:
         self.seq_bucket_counts[h_bucket] = \
             self.seq_bucket_counts.get(h_bucket, 0) + 1
         with stopclock(self.cache.times, "execute"):
+            # THE batched logits pull — the one intentional
+            # host-sync-ok: sync per executed batch (stop-clock discipline)
             out = jax.device_get(exe(self.params, self.model_state, *args))
         if self._moe:
             logits, drop = out
+            # host-sync-ok: `drop` arrived in the device_get above
             self.last_moe_drop_fraction = float(drop)
         else:
             logits = out

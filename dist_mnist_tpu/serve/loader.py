@@ -48,6 +48,30 @@ class ServingBundle:
     rules: ShardingRules
     step: int  # train step the weights came from; 0 on fresh init
     restored: bool
+    #: weight-only quant mode ("int8") when `params` was converted at load
+    #: time; None = full-width float weights (the historical bundle)
+    quant: str | None = None
+    #: ops/quant.error_report of the conversion (per-leaf max error) —
+    #: what ServeMetrics exports as serve/quant_error*
+    quant_report: dict | None = None
+
+
+def quantize_for_serving(params, *, mode: str = "int8"):
+    """The load-time param transform: float checkpoint -> (int8 weights,
+    f32 scales) pytree + per-leaf error report.
+
+    One leaf-selection rule for every architecture
+    (`ops.quant.default_leaf_rule`): matmul/conv kernels (`w`/`w1`/`w2`,
+    2-D+, floating) quantize; biases, norms, embeddings, and the MoE
+    router gate stay float. Quantizing runs eagerly on the restored leaves,
+    so TP/fsdp shard placements survive the conversion."""
+    from dist_mnist_tpu.ops.quant import error_report, quantize_tree
+
+    if mode != "int8":
+        raise ValueError(f"unsupported quant mode {mode!r} "
+                         "(supported: 'int8')")
+    qparams = quantize_tree(params)
+    return qparams, error_report(params, qparams)
 
 
 def load_for_serving(
@@ -57,6 +81,7 @@ def load_for_serving(
     checkpoint_dir: str | Path | None = None,
     step: int | None = None,
     sharding_rules: str | ShardingRules | None = None,
+    quant: str | None = None,
 ) -> ServingBundle:
     """Build everything `InferenceEngine` needs from a config (+ optional
     checkpoint directory). `cfg` may be a config name or a Config.
@@ -109,6 +134,13 @@ def load_for_serving(
         model_state = jax.device_put(
             model_state, tree_sharding(model_state, mesh, rules)
         )
+    quant_report = None
+    if quant:
+        params, quant_report = quantize_for_serving(params, mode=quant)
+        log.info(
+            "quantized %d leaves to %s for serving (max rel err %.2e)",
+            quant_report["n_quantized"], quant,
+            quant_report["max_rel_err"])
     return ServingBundle(
         model=model,
         params=params,
@@ -118,6 +150,8 @@ def load_for_serving(
         rules=rules,
         step=ckpt_step,
         restored=restored is not None,
+        quant=quant or None,
+        quant_report=quant_report,
     )
 
 

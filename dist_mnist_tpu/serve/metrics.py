@@ -48,6 +48,11 @@ class ServeMetrics:
         # — both empty forever on a native-only dense engine
         self.seq_occupancy = StreamingHistogram()
         self.moe_drop_fraction = StreamingHistogram()
+        # int8 weight-only serving (ops/quant.py): per-leaf max-abs
+        # quantization errors of the load-time conversion, recorded once
+        # per report; empty forever on a float engine
+        self.quant_error = StreamingHistogram()
+        self.quant_error_max: float | None = None
 
     def attach_to(self, registry) -> None:
         """Expose the live ladders on a MetricRegistry (-> /metrics)."""
@@ -58,6 +63,19 @@ class ServeMetrics:
         registry.attach_histogram("serve/seq_occupancy", self.seq_occupancy)
         registry.attach_histogram("serve/moe_drop_fraction",
                                   self.moe_drop_fraction)
+        registry.attach_histogram("serve/quant_error", self.quant_error)
+
+    def record_quant_report(self, report: dict) -> None:
+        """Fold an `ops.quant.error_report` in: one histogram observation
+        per quantized leaf (max abs error), plus the scalar max. Called at
+        server construction and again on each quantized hot-swap."""
+        leaves = (report or {}).get("leaves", {})
+        with self._lock:
+            for stats in leaves.values():
+                self.quant_error.observe(stats["max_abs_err"])
+            m = (report or {}).get("max_abs_err")
+            if m is not None:
+                self.quant_error_max = max(self.quant_error_max or 0.0, m)
 
     def record_admitted(self):
         with self._lock:
@@ -146,6 +164,8 @@ class ServeMetrics:
         if drop["count"]:
             out["mean_moe_drop_fraction"] = drop["mean"]
             out["max_moe_drop_fraction"] = drop.get("max", drop["mean"])
+        if self.quant_error_max is not None:
+            out["quant_error_max"] = self.quant_error_max
         return out
 
     def emit(self, writer, step: int, *, queue_depth: int | None = None,
@@ -171,6 +191,8 @@ class ServeMetrics:
         if "mean_moe_drop_fraction" in snap:
             vals["serve/mean_moe_drop_fraction"] = \
                 snap["mean_moe_drop_fraction"]
+        if "quant_error_max" in snap:
+            vals["serve/quant_error_max"] = snap["quant_error_max"]
         if queue_depth is not None:
             vals["serve/queue_depth"] = queue_depth
         if cache:
@@ -180,6 +202,13 @@ class ServeMetrics:
                 vals["serve/cache_evictions"] = cache["evictions"]
             if cache.get("resident_bytes"):
                 vals["serve/resident_bytes"] = cache["resident_bytes"]
+                # which tier the budget is spending on: the weights floor
+                # vs the evictable executable set (PR12's combined gauge
+                # hid the split)
+                vals["serve/resident_bytes_weights"] = \
+                    cache.get("resident_bytes_weights", 0)
+                vals["serve/resident_bytes_executables"] = \
+                    cache.get("resident_bytes_executables", 0)
         batch_write = getattr(writer, "scalars", None)
         if callable(batch_write):
             batch_write(vals, step)

@@ -49,6 +49,11 @@ class InferenceServer:
         self.engine = engine
         self.config = config or ServeConfig()
         self.metrics = ServeMetrics()
+        # a quantized engine's load-time error report becomes the
+        # serve/quant_error* metrics surface right away
+        report = getattr(engine, "quant_report", None)
+        if report:
+            self.metrics.record_quant_report(report)
         self.writer = writer
         # live /healthz state machine (obs/exporter.HealthState or None):
         # serving after start(), draining during close() — so a router can
@@ -176,6 +181,8 @@ class InferenceServer:
         out = self.metrics.snapshot()
         out["queue_depth"] = self.queue_depth
         out["cache"] = self.engine.cache.stats()
+        if getattr(self.engine, "quant", None):
+            out["quant"] = self.engine.quant
         if self._prewarm_error is not None:
             out["prewarm_error"] = repr(self._prewarm_error)
         return out

@@ -207,6 +207,7 @@ def build_zoo_engine(
     memory_budget_mb: float | None = None,
     store=None,
     cache: CompiledModelCache | None = None,
+    quant: str | None = None,
 ) -> InferenceEngine:
     """One factory for every checkpoint the trainer can produce: wires the
     seq grid (when the model can honor masks), the inference-time MoE
@@ -250,9 +251,14 @@ def build_zoo_engine(
 
     budget_bytes = (int(memory_budget_mb * 1024 * 1024)
                     if memory_budget_mb else None)
+    # quant is a first-class grid variant: default to what the loader
+    # already did to the bundle (quantized bundles serve quantized with no
+    # extra plumbing); an explicit `quant` converts engine-side
     return InferenceEngine(
         model, bundle.params, bundle.model_state, mesh,
         model_name=model_name, image_shape=bundle.image_shape,
         rules=bundle.rules, max_bucket=max_bucket, store=store, cache=cache,
         seq_grid=grid, memory_budget_bytes=budget_bytes,
+        quant=quant or getattr(bundle, "quant", None),
+        quant_report=getattr(bundle, "quant_report", None),
     )

@@ -63,6 +63,13 @@ def default_targets(repo_root: Path) -> list[Path]:
     # serve/zoo.py is the zoo's PLANNING layer: grid/mask/byte accounting
     # must stay metadata-only — every device transfer belongs in engine.py
     targets += [pkg / "serve" / "zoo.py"]
+    # the quantized-serving path: ops/quant.py's quantize pass must stay
+    # free of hot-path syncs (its one batched error-report pull and the
+    # load-time degenerate-scale check are the annotated exceptions), and
+    # engine.py/loader.py carry the per-request dispatch + load paths the
+    # quant work rides through
+    targets += [pkg / "ops" / "quant.py", pkg / "serve" / "engine.py",
+                pkg / "serve" / "loader.py"]
     return [t for t in targets if t.exists()]
 
 

@@ -1,0 +1,303 @@
+"""Tier-1 quantized-serving tests (ops/quant.py + the serve stack's
+--quant=int8 path): per-channel scale layout (incl. stacked scan/vmap
+leaves and the per-tensor degenerate fallback), the leaf-selection rule,
+float-vs-int8 parity + exact top-1 agreement across the ladder (dense,
+ViT, MoE, sharded restore), quant-aware cache keys at both tiers, the
+budget-admits-int8 pin, and the compile-free hot-swap re-quantize pin.
+All CPU-mesh; models tiny for the tier-1 time budget."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu.models.registry import get_model
+from dist_mnist_tpu.ops.quant import (
+    QuantizedArray,
+    default_leaf_rule,
+    dequantize,
+    error_report,
+    is_quantized,
+    materialize,
+    quantize,
+    quantize_tree,
+)
+from dist_mnist_tpu.parallel.sharding import resolve_rules
+from dist_mnist_tpu.serve import (
+    ServeMemoryBudgetError,
+    build_zoo_engine,
+    load_for_serving,
+    quantize_for_serving,
+)
+from dist_mnist_tpu.serve.engine import InferenceEngine
+
+IMAGE_SHAPE = (16, 16, 3)
+
+
+def _tiny_vit(**kw):
+    kwargs = dict(depth=1, dim=16, heads=2, patch=4, pool="mean")
+    kwargs.update(kw)
+    return get_model("vit_tiny", **kwargs)
+
+
+def _images(n, shape=(28, 28, 1), seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(n, *shape), dtype=np.uint8)
+
+
+# -- quantize/dequantize unit behavior ----------------------------------------
+
+def test_per_channel_scale_layout_2d_and_stacked():
+    w = jax.random.normal(jax.random.PRNGKey(0), (12, 32))
+    qa = quantize(w)
+    # amax reduces the CONTRACTION axis (ndim-2) only: one scale per
+    # output channel, broadcastable against the int8 payload
+    assert qa.q.shape == (12, 32) and qa.q.dtype == jnp.int8
+    assert qa.scale.shape == (1, 32) and qa.mode == "channel"
+    err = np.abs(np.asarray(dequantize(qa) - w))
+    # symmetric int8: error bounded by scale/2 per channel
+    assert (err <= np.asarray(qa.scale) / 2 + 1e-7).all()
+    # stacked (scan/vmap) leaf keeps its leading dims in the scale, so
+    # lax.scan slices the QuantizedArray exactly like the float original
+    ws = jax.random.normal(jax.random.PRNGKey(1), (4, 12, 48))
+    qs = quantize(ws)
+    assert qs.scale.shape == (4, 1, 48)
+    sliced = jax.tree.map(lambda a: a[2], qs)
+    np.testing.assert_allclose(np.asarray(dequantize(sliced)),
+                               np.asarray(dequantize(qs)[2]), rtol=1e-6)
+
+
+def test_per_tensor_fallback_on_degenerate_channel():
+    w = jnp.zeros((8, 4)).at[:, 0].set(jnp.linspace(-1.0, 1.0, 8))
+    qa = quantize(w)  # columns 1..3 are all-zero -> per-channel degenerate
+    assert qa.mode == "tensor"
+    assert qa.scale.shape == (1, 4)  # broadcast to the keepdims layout
+    np.testing.assert_allclose(np.asarray(dequantize(qa)), np.asarray(w),
+                               atol=float(qa.scale.max()) / 2 + 1e-7)
+    with pytest.raises(ValueError):
+        quantize(jnp.ones((4,)))  # rank < 2 is a caller error
+
+
+def test_leaf_rule_and_quantize_tree_idempotent():
+    tree = {
+        "hid": {"w": jnp.ones((4, 8)), "b": jnp.zeros((8,))},
+        # "gate" is the MoE router's leaf name (parallel/moe.py init):
+        # precision-critical, rank 2, and deliberately NOT in the rule
+        "moe": {"gate": jnp.ones((4, 2)),
+                "w1": jnp.ones((2, 4, 8)), "w2": jnp.ones((2, 8, 4))},
+        "pos": jnp.ones((1, 9, 16)),  # embedding-like: not w/w1/w2
+    }
+    q = quantize_tree(tree)
+    assert isinstance(q["hid"]["w"], QuantizedArray)
+    assert isinstance(q["moe"]["w1"], QuantizedArray)
+    assert isinstance(q["moe"]["w2"], QuantizedArray)
+    # biases, the gate, and embeddings stay float
+    assert not isinstance(q["hid"]["b"], QuantizedArray)
+    assert not isinstance(q["moe"]["gate"], QuantizedArray)
+    assert not isinstance(q["pos"], QuantizedArray)
+    assert is_quantized(q) and not is_quantized(tree)
+    # idempotent: re-running never double-quantizes
+    q2 = quantize_tree(q)
+    assert q2["hid"]["w"] is q["hid"]["w"]
+    # materialize: identity on floats, dequant on QA — the one helper
+    # compute code calls so float baselines stay bit-identical
+    assert materialize(tree["hid"]["w"], jnp.float32) is tree["hid"]["w"]
+    assert materialize(q["hid"]["w"], jnp.float32).dtype == jnp.float32
+    report = error_report(tree, q)
+    assert report["n_quantized"] == 3
+    assert set(report["leaves"]) == {"hid/w", "moe/w1", "moe/w2"}
+    for leaf in report["leaves"].values():
+        assert leaf["max_abs_err"] >= 0.0 and leaf["mode"] == "channel"
+
+
+# -- float-vs-int8 parity across the ladder -----------------------------------
+
+def _agreement(eng_f, eng_q, images, atol):
+    lf, lq = eng_f.predict(images), eng_q.predict(images)
+    np.testing.assert_allclose(lf, lq, atol=atol)
+    return float(np.mean(np.argmax(lf, -1) == np.argmax(lq, -1)))
+
+
+def test_dense_mlp_parity_and_top1_agreement(mesh8):
+    bundle_f = load_for_serving("mlp_mnist", mesh8)
+    bundle_q = load_for_serving("mlp_mnist", mesh8, quant="int8")
+    assert bundle_q.quant == "int8" and bundle_f.quant is None
+    assert bundle_q.quant_report["n_quantized"] == 2
+    eng_f = build_zoo_engine(bundle_f, mesh8, model_name="mlp_f",
+                             max_bucket=8)
+    eng_q = build_zoo_engine(bundle_q, mesh8, model_name="mlp_q",
+                             max_bucket=8)
+    assert eng_q.quant == "int8"
+    # per-channel int8 on an MLP: logits move by well under a decision
+    # boundary on this pool — exact top-1 agreement
+    assert _agreement(eng_f, eng_q, _images(8), atol=0.05) == 1.0
+    ratio = (eng_q.state_bytes_per_device()["param_bytes"]
+             / eng_f.state_bytes_per_device()["param_bytes"])
+    assert ratio < 0.30, f"int8 resident ratio {ratio:.3f}"
+
+
+def test_vit_scan_and_moe_parity(mesh_tp):
+    for kw, name in [({"depth": 2, "scan_blocks": True}, "vq_scan"),
+                     ({"mlp_impl": "moe", "n_experts": 2}, "vq_moe")]:
+        model = _tiny_vit(**kw)
+        params, ms = model.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, *IMAGE_SHAPE), jnp.float32))
+        eng_f = InferenceEngine(
+            model, params, ms, mesh_tp, model_name=name + "_f",
+            image_shape=IMAGE_SHAPE, rules=resolve_rules("tp"), max_bucket=8)
+        eng_q = InferenceEngine(
+            model, quantize_tree(params), ms, mesh_tp,
+            model_name=name + "_q", image_shape=IMAGE_SHAPE,
+            rules=resolve_rules("tp"), max_bucket=8)
+        assert eng_q.quant == "int8"  # auto-detected from the tree
+        images = _images(8, shape=IMAGE_SHAPE, seed=3)
+        # attention + (for moe) routing downstream of quantized matmuls:
+        # wider tolerance than the MLP, agreement still exact on this pool
+        assert _agreement(eng_f, eng_q, images, atol=0.2) == 1.0
+
+
+def test_sharded_restore_serves_quantized(mesh_tp, tmp_path):
+    """fsdp-trained -> TP-served -> int8: quantization happens AFTER the
+    cross-strategy restore and preserves the live placements."""
+    import dataclasses
+
+    from dist_mnist_tpu.checkpoint.manager import CheckpointManager
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.optim import adam
+    from dist_mnist_tpu.train.state import create_train_state
+
+    cfg = get_config("vit_tiny_cifar")
+    cfg = dataclasses.replace(
+        cfg, model_kwargs={"depth": 1, "dim": 16, "heads": 2,
+                           "pool": "mean"},
+        sharding_rules="fsdp")
+    model = get_model(cfg.model, **cfg.model_kwargs)
+    state = create_train_state(model, adam(1e-3),
+                               jax.random.PRNGKey(cfg.seed),
+                               jnp.zeros((1, 32, 32, 3), jnp.float32))
+    mgr = CheckpointManager(tmp_path / "ckpt", async_save=False)
+    assert mgr.save(state)
+    mgr.wait()
+    mgr.close()
+
+    served_f = load_for_serving(cfg, mesh_tp,
+                                checkpoint_dir=tmp_path / "ckpt",
+                                sharding_rules="tp")
+    served_q = load_for_serving(cfg, mesh_tp,
+                                checkpoint_dir=tmp_path / "ckpt",
+                                sharding_rules="tp", quant="int8")
+    assert served_q.restored and served_q.quant == "int8"
+    # the int8 payload kept the restore's NamedSharding (model-axis TP),
+    # not a replicated fallback
+    qkv = [leaf for leaf in jax.tree.leaves(served_q.params,
+                                            is_leaf=lambda x: isinstance(
+                                                x, QuantizedArray))
+           if isinstance(leaf, QuantizedArray)]
+    assert qkv and any(
+        not leaf.q.sharding.is_fully_replicated for leaf in qkv)
+    eng_f = build_zoo_engine(served_f, mesh_tp, model_name="vtp_f",
+                             max_bucket=8)
+    eng_q = build_zoo_engine(served_q, mesh_tp, model_name="vtp_q",
+                             max_bucket=8)
+    images = _images(8, shape=(32, 32, 3), seed=5)
+    assert _agreement(eng_f, eng_q, images, atol=0.2) == 1.0
+    assert eng_q.state_bytes_per_device()["param_bytes"] < \
+        eng_f.state_bytes_per_device()["param_bytes"]
+
+
+# -- memory budget: int8 fits where float refuses -----------------------------
+
+def test_budget_admits_int8_where_float_refuses(mesh8):
+    """The memory-budget pin: a budget sized between the int8 and float
+    weight footprints refuses the float engine at construction and admits
+    the quantized one. Needs a model whose weights dwarf the compiled
+    code (the wide MLP) — on the ladder's tiny models the executables
+    dominate and the comparison would be about XLA code size, not
+    quantization."""
+    model = get_model("mlp", hidden_units=2048)
+    params, ms = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 28, 28, 1), jnp.float32))
+    kw = dict(mesh=mesh8, image_shape=(28, 28, 1),
+              rules=resolve_rules("dp"), max_bucket=8)
+    qparams = quantize_tree(params)
+    q_total = InferenceEngine(model, qparams, ms, model_name="mlpw_q",
+                              **kw).state_bytes_per_device()["total_bytes"]
+    f_total = InferenceEngine(model, params, ms, model_name="mlpw_f",
+                              **kw).state_bytes_per_device()["total_bytes"]
+    assert q_total < 0.30 * f_total
+    budget = (q_total + f_total) // 2
+    with pytest.raises(ServeMemoryBudgetError, match="weights alone"):
+        InferenceEngine(model, params, ms, model_name="mlpw_refuse",
+                        memory_budget_bytes=budget, **kw)
+    eng = InferenceEngine(model, qparams, ms, model_name="mlpw_admit",
+                          memory_budget_bytes=budget, **kw)
+    # prewarm under the armed budget: CPU XLA materializes the
+    # dequantized f32 weights as an executable TEMP buffer (TPU fuses the
+    # dequant into the matmul's operand read), so the compiled cell's
+    # XLA-attributed bytes are model-sized here. Re-arm with that
+    # measured headroom — the budget machinery itself (weights floor +
+    # per-cell accounting) is what this pins, not CPU fusion behavior.
+    probe = InferenceEngine(model, qparams, ms, model_name="mlpw_probe",
+                            **kw)
+    probe.prewarm()
+    exec_bytes = probe.cache.stats()["resident_bytes_executables"]
+    eng.cache.set_budget(budget + exec_bytes + 64 * 1024,
+                         base_bytes=q_total)
+    assert eng.prewarm() > 0  # the int8 grid sits resident under budget
+    assert eng.predict(_images(4)).shape == (4, 10)
+
+
+# -- hot swap re-quantizes without recompiling --------------------------------
+
+def test_hot_swap_requantizes_float_tree_compile_free(mesh8):
+    bundle = load_for_serving("mlp_mnist", mesh8, quant="int8")
+    eng = build_zoo_engine(bundle, mesh8, model_name="mlp_swap",
+                           max_bucket=8)
+    eng.prewarm()
+    misses0 = eng.cache.misses
+    # the rollout path hands full-width float checkpoints to a quantized
+    # replica: swap must quantize on the fly, not recompile or refuse
+    float_bundle = load_for_serving("mlp_mnist", mesh8)
+    new_params = jax.tree.map(lambda p: p + 0.5, float_bundle.params)
+    eng.swap_weights(new_params, float_bundle.model_state, version=2)
+    assert is_quantized(eng.params) and eng.weights_version == 2
+    eng.predict(_images(8, seed=7))
+    assert eng.cache.misses == misses0, "hot-swap caused a recompile"
+
+
+# -- quant-aware cache keys ---------------------------------------------------
+
+def test_engine_cache_keys_fold_quant_in(mesh8):
+    bundle_f = load_for_serving("mlp_mnist", mesh8)
+    bundle_q = load_for_serving("mlp_mnist", mesh8, quant="int8")
+    eng_f = build_zoo_engine(bundle_f, mesh8, model_name="mlp",
+                             max_bucket=8)
+    eng_q = build_zoo_engine(bundle_q, mesh8, model_name="mlp",
+                             max_bucket=8)
+    # same model name, same bucket: quant must split BOTH cache tiers —
+    # an int8 engine must never execute (or disk-load) a float program
+    assert eng_f._key(8) != eng_q._key(8)
+    assert eng_f._store_key(8) != eng_q._store_key(8)
+    # and the float keys are byte-identical to the pre-quant format, so
+    # existing warm disk caches survive the feature landing
+    assert "quant" not in eng_f._store_key(8)
+    assert "wint8" in eng_q._key(8)[3]
+
+
+def test_train_compile_cache_key_fields_fold_quant_in(mesh8):
+    from dist_mnist_tpu.cli.train import compile_cache_key_fields
+    from dist_mnist_tpu.compilecache.store import cache_key
+    from dist_mnist_tpu.configs import get_config
+
+    cfg = get_config("mlp_mnist")
+    base = compile_cache_key_fields(cfg, mesh8)
+    quant = compile_cache_key_fields(cfg, mesh8, quant="int8")
+    none = compile_cache_key_fields(cfg, mesh8, quant="none")
+    assert cache_key({"kind": "serve", **base}) != \
+        cache_key({"kind": "serve", **quant})
+    # "none" is the no-op spelling: identical fields -> identical key,
+    # keeping every historical cache entry warm
+    assert base == none and "quant" not in base
