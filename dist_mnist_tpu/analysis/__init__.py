@@ -1,0 +1,15 @@
+"""graftlint: the repo's AST-based static-analysis suite.
+
+Run it: ``python -m dist_mnist_tpu.analysis`` (see cli.py / the rule
+catalog in docs/ANALYSIS.md). Import surface for tests and the
+scripts/check_host_sync.py shim:
+
+    from dist_mnist_tpu.analysis import core, baseline, rules
+
+Stdlib-only by design — importing this package must never pull jax (the
+root package's PEP 562 lazy exports keep `import dist_mnist_tpu` free of
+it too), so the lint runs in seconds anywhere.
+"""
+
+from dist_mnist_tpu.analysis import baseline, cli, core, rules  # noqa: F401
+from dist_mnist_tpu.analysis.core import Context, Finding, Rule  # noqa: F401

@@ -1,0 +1,77 @@
+"""Committed baseline for grandfathered graftlint findings.
+
+The baseline keeps the suite green while carrying known, *reasoned*
+debt: each entry names a rule, a path, a message substring to match, and
+the one-line reason it is allowed to stand. Matching ignores line
+numbers (they drift under every edit); an entry matches any finding with
+the same rule + path whose message contains `match`.
+
+The file lives at `<repo>/.graftlint_baseline.json` so it reads as repo
+state, not package code:
+
+    {"entries": [
+      {"rule": "bench-stages", "path": "bench.py",
+       "match": "--async-save",
+       "reason": "parameterization of the --ckpt leg, not a stage"}
+    ]}
+
+Stale entries (matching nothing) are reported as warnings — delete them
+when the debt is paid. Entries without a reason are hard errors: the
+reason IS the point.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from dist_mnist_tpu.analysis.core import Finding
+
+DEFAULT_NAME = ".graftlint_baseline.json"
+
+
+class BaselineError(ValueError):
+    pass
+
+
+class Baseline:
+    def __init__(self, entries: list[dict]):
+        for i, e in enumerate(entries):
+            missing = {"rule", "path", "match", "reason"} - set(e)
+            if missing:
+                raise BaselineError(
+                    f"baseline entry {i} missing {sorted(missing)}")
+            if not str(e["reason"]).strip():
+                raise BaselineError(
+                    f"baseline entry {i} ({e['rule']} {e['path']}) has an "
+                    f"empty reason — every grandfathered finding carries "
+                    f"its why")
+        self.entries = entries
+        self._hits = [0] * len(entries)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.is_file():
+            return cls([])
+        data = json.loads(path.read_text())
+        return cls(list(data.get("entries", [])))
+
+    def matches(self, f: Finding) -> bool:
+        hit = False
+        for i, e in enumerate(self.entries):
+            if (e["rule"] == f.rule and e["path"] == f.path
+                    and e["match"] in f.message):
+                self._hits[i] += 1
+                hit = True
+        return hit
+
+    def partition(self, findings: list[Finding]
+                  ) -> tuple[list[Finding], list[Finding]]:
+        """(new, baselined)"""
+        new, old = [], []
+        for f in findings:
+            (old if self.matches(f) else new).append(f)
+        return new, old
+
+    def stale_entries(self) -> list[dict]:
+        return [e for e, hits in zip(self.entries, self._hits) if not hits]

@@ -1,0 +1,134 @@
+"""graftlint CLI: `python -m dist_mnist_tpu.analysis`.
+
+Exit 0 when every finding is suppressed or baselined, 1 otherwise (2 on
+usage errors). Default output is `path:line: rule-id message`, one per
+line; `--json` emits one machine-readable object (schema below). Keeps
+to stdlib imports only — a full-tree run must finish in seconds with no
+accelerator stack.
+
+    python -m dist_mnist_tpu.analysis                 # whole tree
+    python -m dist_mnist_tpu.analysis --json
+    python -m dist_mnist_tpu.analysis --rules host-sync,bench-stages
+    python -m dist_mnist_tpu.analysis --changed-only  # git-diff scoped
+
+JSON schema (stable; tests pin it):
+
+    {"version": 1, "rules": [...], "findings": [
+        {"rule", "path", "line", "message"}],
+     "baselined": N, "suppressed": N, "stale_baseline": [entries]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from dist_mnist_tpu.analysis import baseline as baseline_mod
+from dist_mnist_tpu.analysis import rules as rules_mod
+from dist_mnist_tpu.analysis.core import Context, run
+
+
+def repo_root_from(package_dir: Path | None = None) -> Path:
+    here = package_dir or Path(__file__).resolve().parent
+    return here.parent.parent
+
+
+def _changed_paths(repo_root: Path) -> set[str] | None:
+    """Repo-relative changed files (staged + unstaged + untracked); None
+    when git is unavailable — callers fall back to a full run."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30)
+        status = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode or status.returncode:
+        return None
+    paths = set(diff.stdout.split())
+    for line in status.stdout.splitlines():
+        if line[:2].strip() and len(line) > 3:
+            paths.add(line[3:].strip())
+    return paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dist_mnist_tpu.analysis",
+        description="graftlint: AST static analysis for this repo's "
+                    "trace-safety / SPMD / lifecycle / drift invariants")
+    ap.add_argument("--json", action="store_true", dest="json_out",
+                    help="machine-readable output (one JSON object)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         f"<repo>/{baseline_mod.DEFAULT_NAME})")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only findings on files changed vs git "
+                         "HEAD (rules still see the whole tree)")
+    ap.add_argument("--repo-root", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in rules_mod.ALL_RULES:
+            print(f"{rule.rule_id:18s} {rule.doc}")
+        return 0
+
+    repo_root = (Path(args.repo_root).resolve() if args.repo_root
+                 else repo_root_from())
+    try:
+        selected = rules_mod.select(
+            [r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None)
+    except KeyError as err:
+        print(err.args[0], file=sys.stderr)
+        return 2
+
+    bl_path = (Path(args.baseline) if args.baseline
+               else repo_root / baseline_mod.DEFAULT_NAME)
+    try:
+        bl = baseline_mod.Baseline.load(bl_path)
+    except (baseline_mod.BaselineError, json.JSONDecodeError) as err:
+        print(f"bad baseline {bl_path}: {err}", file=sys.stderr)
+        return 2
+
+    changed = None
+    if args.changed_only:
+        paths = _changed_paths(repo_root)
+        if paths is not None:
+            changed = lambda rel: rel in paths  # noqa: E731
+
+    ctx = Context(repo_root)
+    result = run(ctx, selected, changed_only=changed)
+    new, baselined = bl.partition(result["findings"])
+    stale = bl.stale_entries() if changed is None else []
+
+    if args.json_out:
+        print(json.dumps({
+            "version": 1,
+            "rules": result["rules"],
+            "findings": [f.to_json() for f in new],
+            "baselined": len(baselined),
+            "suppressed": result["suppressed"],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"warning: stale baseline entry {e['rule']} {e['path']} "
+                  f"({e['match']!r} matched nothing) — debt paid, delete "
+                  f"it", file=sys.stderr)
+        if new:
+            print(f"\n{len(new)} finding(s) "
+                  f"({len(baselined)} baselined, "
+                  f"{result['suppressed']} suppressed).", file=sys.stderr)
+    return 1 if new else 0

@@ -1,0 +1,258 @@
+"""graftlint core: one parse, many visitors.
+
+The engine owns everything rule-independent so each rule stays a small
+AST (or cross-artifact) check:
+
+- **SourceFile** — a file parsed ONCE (`ast` tree + comment-derived
+  suppression table); every rule sees the same parse, so a full-tree run
+  is one `ast.parse` per file no matter how many rules are active.
+- **Suppressions** — ``# lint: ok[rule-id] reason`` blesses its own line
+  and the line below (marker-above style for statements that would
+  overflow the line). Several ids may share one marker
+  (``ok[rule-a,rule-b]``). The legacy ``# host-sync-ok: reason`` marker
+  from scripts/check_host_sync.py is honored as ``ok[host-sync]`` so the
+  shim CLI keeps its contract. A marker with NO reason is itself a
+  finding (`suppression-hygiene`): the reason is the reviewable artifact.
+- **Baseline** — see `baseline.py`: grandfathered findings, each entry
+  carrying a reason, matched by (rule, path, message substring) so line
+  drift doesn't invalidate entries.
+
+Rules implement the tiny `Rule` protocol below and register in
+`rules/__init__.py`. Nothing in this package may import jax: the suite
+must run (and finish in seconds) on a machine with no accelerator stack
+warmed up.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Callable, Iterable
+
+SUPPRESS_RE = re.compile(r"lint:\s*ok\[([a-z0-9_,\- ]+)\]\s*(.*)")
+LEGACY_HOST_SYNC_RE = re.compile(r"host-sync-ok:?\s*(.*)")
+#: tag/event hygiene shared by the drift rules and the obs test suite
+TAG_RE = re.compile(r"^[a-z0-9_/.]+$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    line: int  # the comment's own line; blesses `line` and `line + 1`
+    rules: frozenset[str]
+    reason: str
+    legacy: bool = False
+
+
+class SourceFile:
+    """One file, parsed once: `tree` (None on syntax error) + the
+    suppression table mined from its comment tokens."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.lines = self.text.splitlines(keepends=True)
+        try:
+            self.tree: ast.Module | None = ast.parse(self.text)
+            self.parse_error: str | None = None
+        except SyntaxError as err:
+            self.tree = None
+            self.parse_error = f"unparseable: {err}"
+        self.suppressions: list[Suppression] = []
+        self._blessed: dict[int, set[str]] = {}
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            comments = [t for t in tokens if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return
+        for tok in comments:
+            m = SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+                sup = Suppression(tok.start[0], rules, m.group(2).strip())
+            else:
+                m = LEGACY_HOST_SYNC_RE.search(tok.string)
+                if not m:
+                    continue
+                sup = Suppression(tok.start[0], frozenset({"host-sync"}),
+                                  m.group(1).strip(), legacy=True)
+            self.suppressions.append(sup)
+            for line in (sup.line, sup.line + 1):
+                self._blessed.setdefault(line, set()).update(sup.rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        return rule in self._blessed.get(line, ())
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule, self.rel, int(line), message)
+
+
+class Context:
+    """Everything a rule may look at: the repo tree, the shared parse
+    cache, and the package file list. Cross-artifact rules read docs and
+    shell scripts through `read_text` so even non-Python artifacts go
+    through one access point (and one place to handle absence)."""
+
+    def __init__(self, repo_root: Path, package: str = "dist_mnist_tpu"):
+        self.repo_root = Path(repo_root)
+        self.package = package
+        self._cache: dict[str, SourceFile] = {}
+
+    # -- files ---------------------------------------------------------------
+    def source(self, rel: str | Path) -> SourceFile | None:
+        rel = str(Path(rel).as_posix())
+        if rel not in self._cache:
+            path = self.repo_root / rel
+            if not path.is_file():
+                return None
+            self._cache[rel] = SourceFile(path, rel)
+        return self._cache[rel]
+
+    def package_files(self) -> list[str]:
+        pkg = self.repo_root / self.package
+        out = []
+        for p in sorted(pkg.rglob("*.py")):
+            rel = p.relative_to(self.repo_root).as_posix()
+            if "analysis/" in rel:
+                continue  # the linter doesn't lint itself for hot-path rules
+            out.append(rel)
+        return out
+
+    def package_sources(self) -> Iterable[SourceFile]:
+        for rel in self.package_files():
+            sf = self.source(rel)
+            if sf is not None:
+                yield sf
+
+    def read_text(self, rel: str) -> str | None:
+        path = self.repo_root / rel
+        return path.read_text() if path.is_file() else None
+
+
+class Rule:
+    """Protocol-by-convention: subclasses set `rule_id`/`doc` and
+    implement `check`. Kept as a base class (not typing.Protocol) so the
+    registry can assert isinstance at import time."""
+
+    rule_id: str = ""
+    doc: str = ""
+
+    def check(self, ctx: Context) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def suppression_hygiene(ctx: Context,
+                        files: Iterable[SourceFile]) -> list[Finding]:
+    """Reasonless markers are findings themselves: a suppression without
+    a why is just a louder way to disable the lint."""
+    out = []
+    for sf in files:
+        for sup in sf.suppressions:
+            if not sup.reason:
+                marker = ("# host-sync-ok:" if sup.legacy
+                          else "# lint: ok[...]")
+                out.append(sf.finding(
+                    "suppression-hygiene", sup.line,
+                    f"suppression `{marker}` carries no reason; write "
+                    f"`# lint: ok[rule-id] <why>`"))
+    return out
+
+
+def run(ctx: Context, rules: list[Rule], *,
+        changed_only: Callable[[str], bool] | None = None) -> dict:
+    """Run `rules`, apply suppressions, and return the raw result dict
+    (baseline partitioning happens in cli.py, where the baseline file is
+    resolved). `changed_only` filters findings by path AFTER the rules
+    ran — cross-artifact rules need the whole tree to compute drift even
+    when only one artifact changed."""
+    findings: list[Finding] = []
+    suppressed = 0
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    findings.extend(
+        suppression_hygiene(ctx, list(ctx._cache.values())))
+    kept = []
+    for f in findings:
+        sf = ctx.source(f.path)
+        if sf is not None and sf.is_suppressed(f.rule, f.line):
+            suppressed += 1
+            continue
+        kept.append(f)
+    if changed_only is not None:
+        kept = [f for f in kept if changed_only(f.path)]
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return {"findings": kept, "suppressed": suppressed,
+            "rules": [r.rule_id for r in rules]}
+
+
+# -- small AST helpers shared by rules ----------------------------------------
+
+def call_name(node: ast.Call) -> tuple[str | None, bool]:
+    """(name, is_method) for a call: `f(...)` -> ("f", False),
+    `x.f(...)` -> ("f", True), anything else -> (None, False)."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id, False
+    if isinstance(fn, ast.Attribute):
+        return fn.attr, True
+    return None, False
+
+
+def const_str(node: ast.AST | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def str_prefix(node: ast.AST | None) -> tuple[str | None, bool]:
+    """(prefix, exact): a literal string gives (value, True); an f-string
+    with a leading constant gives (that constant, False); else (None, _).
+    Rules use the inexact prefix for namespace checks on tags like
+    f"memory/{k}"."""
+    s = const_str(node)
+    if s is not None:
+        return s, True
+    if (isinstance(node, ast.JoinedStr) and node.values
+            and isinstance(node.values[0], ast.Constant)
+            and isinstance(node.values[0].value, str)
+            and node.values[0].value):
+        return node.values[0].value, False
+    return None, False
+
+
+def node_source(sf: SourceFile, node: ast.AST) -> str:
+    """ast.get_source_segment, but against the file's cached line list —
+    the stock helper re-splits the whole file per call, which made the
+    spmd rule (one call per `if` in the package) the runtime hot spot."""
+    try:
+        sl, sc = node.lineno - 1, node.col_offset
+        el, ec = node.end_lineno - 1, node.end_col_offset
+    except AttributeError:
+        return ""
+    lines = sf.lines
+    if sl == el:
+        return lines[sl][sc:ec]
+    return lines[sl][sc:] + "".join(lines[sl + 1:el]) + lines[el][:ec]

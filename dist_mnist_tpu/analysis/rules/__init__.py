@@ -1,0 +1,43 @@
+"""graftlint rule registry.
+
+A rule module exposes one or more `Rule` instances; list them here to
+activate. `python -m dist_mnist_tpu.analysis --rules a,b` subsets by
+`rule_id`. Adding a rule = new module + one registry line + a fixture
+pair in tests/test_analysis.py (docs/ANALYSIS.md "Adding a rule").
+"""
+
+from __future__ import annotations
+
+from dist_mnist_tpu.analysis.core import Rule
+from dist_mnist_tpu.analysis.rules import (
+    bench_stages,
+    cache_key,
+    host_sync,
+    registry_drift,
+    spmd_divergence,
+    thread_lifecycle,
+)
+
+ALL_RULES: list[Rule] = [
+    host_sync.RULE,
+    spmd_divergence.RULE,
+    cache_key.RULE,
+    thread_lifecycle.RULE,
+    registry_drift.RULE,
+    registry_drift.METRIC_RULE,
+    bench_stages.RULE,
+]
+
+RULE_IDS = [r.rule_id for r in ALL_RULES]
+
+assert len(set(RULE_IDS)) == len(RULE_IDS), "duplicate rule ids"
+
+
+def select(ids: list[str] | None) -> list[Rule]:
+    if not ids:
+        return list(ALL_RULES)
+    unknown = set(ids) - set(RULE_IDS)
+    if unknown:
+        raise KeyError(
+            f"unknown rule id(s) {sorted(unknown)}; have {RULE_IDS}")
+    return [r for r in ALL_RULES if r.rule_id in ids]

@@ -1,0 +1,118 @@
+"""bench-stages: bench.py modes stay wired into both measurement scripts.
+
+Every measurement-day battery script must know every bench mode, or a
+subsystem silently stops being measured: `measure_all.sh` runs the full
+battery and `retry_missed_stages.sh` re-runs the catch-up pass, and each
+new `bench.py --<stage>` flag historically had to be added to BOTH by
+hand (PR 9's regression gate reads whichever artifacts they produce).
+
+The rule parses bench.py's argparse calls for `store_true` mode flags
+and checks each appears (as a ``--flag`` occurrence) in both scripts.
+A flag that is deliberately NOT a battery stage (a parameterization of
+another stage) belongs in the committed baseline with its reason — that
+is the allowlist for this rule.
+
+The reverse direction catches typos: every ``bench.py ... --x`` flag the
+scripts pass must be one bench.py actually defines.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dist_mnist_tpu.analysis.core import Context, Finding, Rule, const_str
+
+BENCH_PATH = "bench.py"
+SCRIPTS = ("scripts/measure_all.sh", "scripts/retry_missed_stages.sh")
+_SH_BENCH_LINE = re.compile(r"python bench\.py([^\n]*)")
+_SH_FLAG = re.compile(r"--([a-z][a-z0-9-]*)")
+
+
+def bench_store_true_flags(ctx: Context) -> dict[str, int]:
+    """{--flag: lineno} for bench.py's `store_true` arguments."""
+    sf = ctx.source(BENCH_PATH)
+    if sf is None or sf.tree is None:
+        return {}
+    out: dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if (not isinstance(node, ast.Call)
+                or not isinstance(node.func, ast.Attribute)
+                or node.func.attr != "add_argument"):
+            continue
+        action = next((const_str(kw.value) for kw in node.keywords
+                       if kw.arg == "action"), None)
+        if action != "store_true":
+            continue
+        for arg in node.args:
+            s = const_str(arg)
+            if s and s.startswith("--"):
+                out[s] = node.lineno
+    return out
+
+
+def bench_all_flags(ctx: Context) -> set[str]:
+    sf = ctx.source(BENCH_PATH)
+    if sf is None or sf.tree is None:
+        return set()
+    out: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            for arg in node.args:
+                s = const_str(arg)
+                if s and s.startswith("--"):
+                    out.add(s)
+    return out
+
+
+def script_bench_flags(text: str) -> set[str]:
+    flags: set[str] = set()
+    for m in _SH_BENCH_LINE.finditer(text):
+        flags.update(f"--{f}" for f in _SH_FLAG.findall(m.group(1)))
+    return flags
+
+
+class BenchStagesRule(Rule):
+    rule_id = "bench-stages"
+    doc = ("every bench.py store_true mode flag appears in measure_all.sh "
+           "AND retry_missed_stages.sh (baseline = intentional "
+           "parameterizations)")
+
+    def check(self, ctx: Context) -> list[Finding]:
+        modes = bench_store_true_flags(ctx)
+        if not modes:
+            return [Finding(self.rule_id, BENCH_PATH, 1,
+                            "found no store_true flags in bench.py — "
+                            "parser moved?")]
+        all_flags = bench_all_flags(ctx)
+        out: list[Finding] = []
+        script_flags: dict[str, set[str]] = {}
+        for rel in SCRIPTS:
+            text = ctx.read_text(rel)
+            if text is None:
+                out.append(Finding(self.rule_id, rel, 1, "script missing"))
+                continue
+            script_flags[rel] = script_bench_flags(text)
+        for flag, lineno in sorted(modes.items()):
+            missing = [rel for rel, flags in script_flags.items()
+                       if flag not in flags]
+            if missing:
+                out.append(Finding(
+                    self.rule_id, BENCH_PATH, lineno,
+                    f"bench mode {flag} is not exercised by "
+                    f"{', '.join(missing)} — add a stage (or baseline it "
+                    f"with the reason it is a parameterization, not a "
+                    f"stage)"))
+        # reverse: scripts must not pass flags bench.py doesn't define
+        for rel, flags in script_flags.items():
+            for flag in sorted(flags - all_flags):
+                out.append(Finding(
+                    self.rule_id, rel, 1,
+                    f"{rel} passes {flag} to bench.py, which defines no "
+                    f"such flag — typo'd or removed stage"))
+        return out
+
+
+RULE = BenchStagesRule()

@@ -1,0 +1,127 @@
+"""cache-key: every compile-relevant Config knob folds into the key.
+
+PR 7 retrofitted the overlap knobs into `compile_cache_key_fields` by
+hand after a stale serial executable could have served an overlapped
+run. This rule makes the invariant structural: diff the fields of the
+`Config` dataclass (configs.py) against the ``cfg.<field>`` reads inside
+`cli/train.py compile_cache_key_fields`. A field that is neither read by
+the key builder nor on the explicit runtime-only allowlist is a finding
+— new knobs default to "invalidates the cache" until someone argues
+otherwise IN the allowlist, with a reason.
+
+Why the default is compile-relevant: most Config scalars are closed over
+by the jitted step (learning-rate schedules bake their constants,
+grad-clip/weight-decay change the optimizer chain's structure), so a
+cache hit across a changed value silently runs the OLD program with the
+old constant — the numbers drift, nothing crashes.
+
+A second, narrower check pins the serve path: `serve/engine.py` must
+mention "quant" in both its in-memory and disk key builders (PR 13's
+invariant — an int8 program can never satisfy a float key).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dist_mnist_tpu.analysis.core import Context, Finding, Rule
+
+CONFIGS_PATH = "dist_mnist_tpu/configs.py"
+KEY_BUILDER_PATH = "dist_mnist_tpu/cli/train.py"
+KEY_BUILDER_FN = "compile_cache_key_fields"
+ENGINE_PATH = "dist_mnist_tpu/serve/engine.py"
+
+#: runtime-only knobs: change the run, not the compiled program.
+#: Every entry carries its why — this allowlist is the reviewable
+#: artifact, exactly like a suppression reason.
+RUNTIME_ONLY: dict[str, str] = {
+    "name": "already folded as the key's `config` field",
+    "eval_every": "hook cadence; never traced",
+    "log_every": "hook cadence; never traced",
+    "checkpoint_every_secs": "saver cadence; never traced",
+    "elastic_batch_policy": "resolved pre-run into batch_size/learning_rate,"
+                            " which ARE keyed",
+    "seed": "changes initial weights (data), not the traced program",
+    "ladder_devices": "bench-ladder sizing metadata; never traced",
+    "mesh": "the LIVE mesh shape is keyed from the constructed Mesh "
+            "argument instead (a MeshSpec of -1s is unresolved)",
+}
+
+
+def _config_fields(ctx: Context) -> dict[str, int]:
+    """{field: lineno} of the Config dataclass's annotated fields."""
+    sf = ctx.source(CONFIGS_PATH)
+    if sf is None or sf.tree is None:
+        return {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return {
+                stmt.target.id: stmt.lineno
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            }
+    return {}
+
+
+def _keyed_fields(ctx: Context) -> set[str] | None:
+    """Config attributes the key builder reads (`cfg.X` anywhere in it)."""
+    sf = ctx.source(KEY_BUILDER_PATH)
+    if sf is None or sf.tree is None:
+        return None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == KEY_BUILDER_FN:
+            reads = set()
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "cfg"):
+                    reads.add(sub.attr)
+            return reads
+    return None
+
+
+class CacheKeyRule(Rule):
+    rule_id = "cache-key"
+    doc = ("Config dataclass fields missing from compile_cache_key_fields "
+           "and not allowlisted as runtime-only")
+
+    def check(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        fields = _config_fields(ctx)
+        keyed = _keyed_fields(ctx)
+        if not fields:
+            return [Finding(self.rule_id, CONFIGS_PATH, 1,
+                            "could not locate the Config dataclass")]
+        if keyed is None:
+            return [Finding(self.rule_id, KEY_BUILDER_PATH, 1,
+                            f"could not locate {KEY_BUILDER_FN}()")]
+        for field, lineno in sorted(fields.items()):
+            if field in keyed or field in RUNTIME_ONLY:
+                continue
+            out.append(Finding(
+                self.rule_id, CONFIGS_PATH, lineno,
+                f"Config.{field} is read by neither "
+                f"{KEY_BUILDER_FN}() nor the RUNTIME_ONLY allowlist — a "
+                f"cached executable compiled under a different "
+                f"{field} would be served silently; fold it into the key "
+                f"or allowlist it with a reason "
+                f"(analysis/rules/cache_key.py)"))
+        # stale allowlist entries: a field that vanished from Config
+        for field in sorted(RUNTIME_ONLY):
+            if field not in fields:
+                out.append(Finding(
+                    self.rule_id, CONFIGS_PATH, 1,
+                    f"RUNTIME_ONLY allowlists {field!r}, which is no "
+                    f"longer a Config field — drop the entry"))
+        # serve path: quant must stay folded into both engine key tiers
+        engine = ctx.read_text(ENGINE_PATH)
+        if engine is not None and engine.count("quant") < 2:
+            out.append(Finding(
+                self.rule_id, ENGINE_PATH, 1,
+                "serve engine no longer folds `quant` into its cache "
+                "keys — an int8 program could satisfy a float key"))
+        return out
+
+
+RULE = CacheKeyRule()

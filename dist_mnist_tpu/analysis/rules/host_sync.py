@@ -1,0 +1,120 @@
+"""host-sync: no blocking device->host transfers in hot-path functions.
+
+AST re-implementation of scripts/check_host_sync.py (which is now a thin
+shim over this rule). Same flagged constructs — bare ``float(``,
+``.item()`` methods, bare or qualified ``device_get(`` — but with real
+scoping instead of whole-file token scanning:
+
+- Only code INSIDE function/lambda bodies counts. Module-level calls run
+  once at import, not per step; the tokenize version flagged them too,
+  which is why its scope had to stay narrow. (A nested function inherits
+  the hot-path verdict of its enclosing module either way.)
+- Comments and docstrings can't trigger it by construction.
+
+The scanned module set is the same curated hot-path list the tokenize
+lint grew PR over PR (train/, faults/, the prefetch worker, hook cadence
+paths, the overlap schedule, and the serve dispatch/load paths); it
+lives here now as `HOT_PATH_TARGETS`.
+
+Suppress with ``# lint: ok[host-sync] <why>`` (the legacy
+``# host-sync-ok: <why>`` marker is still honored for the shim CLI).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from dist_mnist_tpu.analysis.core import (
+    Context, Finding, Rule, SourceFile, call_name)
+
+ANY_NAMES = ("device_get",)     # bare or attribute-qualified
+BARE_NAMES = ("float",)         # builtin only; `t.float()` is torch-style
+METHOD_NAMES = ("item",)        # method only; bare `item(` is unrelated
+
+#: the hot-path module set, repo-relative (glob entries end with /*.py)
+HOT_PATH_TARGETS = (
+    "dist_mnist_tpu/train/*.py",
+    "dist_mnist_tpu/faults/*.py",
+    "dist_mnist_tpu/data/prefetch.py",
+    "dist_mnist_tpu/hooks/builtin.py",
+    "dist_mnist_tpu/parallel/overlap.py",
+    "dist_mnist_tpu/serve/zoo.py",
+    "dist_mnist_tpu/ops/quant.py",
+    "dist_mnist_tpu/serve/engine.py",
+    "dist_mnist_tpu/serve/loader.py",
+)
+
+
+def hot_path_files(repo_root: Path) -> list[Path]:
+    out: list[Path] = []
+    for pat in HOT_PATH_TARGETS:
+        if pat.endswith("*.py"):
+            out.extend(sorted((repo_root / pat[:-len("*.py")]).glob("*.py")))
+        else:
+            p = repo_root / pat
+            if p.exists():
+                out.append(p)
+    return out
+
+
+def _sync_calls(tree: ast.Module):
+    """Yield (node, name, is_method) for every flagged call that sits
+    inside a function or lambda body."""
+    # collect the line spans of every function body; a call is hot-path
+    # only if some def encloses it
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            spans.append((node.lineno, getattr(node, "end_lineno",
+                                               node.lineno)))
+
+    def in_function(call: ast.Call) -> bool:
+        return any(a <= call.lineno <= b for a, b in spans)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name, is_method = call_name(node)
+        if name is None:
+            continue
+        if (name in ANY_NAMES
+                or (name in BARE_NAMES and not is_method)
+                or (name in METHOD_NAMES and is_method)):
+            if in_function(node):
+                yield node, name, is_method
+
+
+def scan_source(sf: SourceFile) -> list[Finding]:
+    """Unsuppressed-yet findings for one file (suppressions are applied
+    by the engine; the shim applies them itself for standalone files)."""
+    if sf.tree is None:
+        return [sf.finding("host-sync", 1, sf.parse_error or "unparseable")]
+    out = []
+    for node, name, is_method in _sync_calls(sf.tree):
+        what = f".{name}()" if is_method else f"{name}("
+        out.append(sf.finding(
+            "host-sync", node,
+            f"{what} in a hot-path module is a blocking device->host "
+            f"sync; batch it or annotate with `# lint: ok[host-sync] "
+            f"<why>` (legacy `# host-sync-ok: <why>` honored)"))
+    return out
+
+
+class HostSyncRule(Rule):
+    rule_id = "host-sync"
+    doc = ("blocking device->host syncs (float()/.item()/device_get) in "
+           "hot-path functions")
+
+    def check(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for path in hot_path_files(ctx.repo_root):
+            rel = path.relative_to(ctx.repo_root).as_posix()
+            sf = ctx.source(rel)
+            if sf is not None:
+                out.extend(scan_source(sf))
+        return out
+
+
+RULE = HostSyncRule()

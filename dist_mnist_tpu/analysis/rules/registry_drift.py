@@ -1,0 +1,240 @@
+"""journal-drift / metric-drift: code and docs/OBSERVABILITY.md agree.
+
+docs/OBSERVABILITY.md carries two contracts as markdown tables: the
+journal **event vocabulary** ("Event vocabulary and emitters") and the
+**metric namespace** table. Both drifted after PRs 10-13 added events
+and series faster than the tables grew rows. Two rule ids, one module:
+
+- ``journal-drift`` — every literal event name passed to
+  ``events.emit("...")`` / ``journal.emit("...")`` must appear in the
+  event table, and every documented event must still exist in code
+  (documented-but-dead names rot the doc's authority). Names must obey
+  the tag hygiene charset ``^[a-z0-9_/.]+$``.
+- ``metric-drift`` — every literal metric tag fed to a writer/registry
+  sink (``scalar``/``histogram``/``attach_histogram``/``gauge``/
+  ``counter`` first args, and literal dict keys passed straight to
+  ``scalars``/``set_scalars``) must match a documented name or a
+  documented ``ns/*`` wildcard. f-string tags check their leading
+  constant prefix. The reverse check is deliberately lenient: a
+  documented name/namespace is "live" if ANY string literal in the
+  package equals it or starts with the wildcard's prefix — most hook
+  tags are built in dicts the forward scan can't see.
+
+Doc parsing keys on backtick spans inside table rows, so prose around
+the names can change freely; only the `code`-quoted vocabulary binds.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dist_mnist_tpu.analysis.core import (
+    TAG_RE, Context, Finding, Rule, str_prefix)
+
+DOC_PATH = "docs/OBSERVABILITY.md"
+EVENT_TABLE_HEADER = "| event | emitter |"
+METRIC_TABLE_HEADER = "| namespace | source |"
+SINKS_FIRST_ARG = frozenset({
+    "scalar", "histogram", "attach_histogram", "gauge", "counter"})
+SINKS_DICT_ARG = frozenset({"scalars", "set_scalars"})
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+#: names render with glob stars in the doc (`fleet/*`); events never do
+_NAME_OK = re.compile(r"^[a-z0-9_/.*]+$")
+
+
+def _table_rows(text: str, header: str) -> list[tuple[int, str]]:
+    """(lineno, first_cell) per data row of the table whose header row
+    starts with `header`."""
+    rows = []
+    in_table = False
+    for i, line in enumerate(text.splitlines(), 1):
+        if line.startswith(header):
+            in_table = True
+            continue
+        if in_table:
+            if not line.startswith("|"):
+                break
+            cells = line.split("|")
+            if len(cells) > 1 and not set(cells[1].strip()) <= {"-"}:
+                rows.append((i, cells[1]))
+    return rows
+
+
+def _doc_names(text: str, header: str) -> dict[str, int]:
+    """{backticked-name: lineno} from a table's first column."""
+    out: dict[str, int] = {}
+    for lineno, cell in _table_rows(text, header):
+        for name in _BACKTICK_RE.findall(cell):
+            name = name.strip()
+            if _NAME_OK.match(name):
+                out.setdefault(name, lineno)
+    return out
+
+
+# -- code-side collection -----------------------------------------------------
+
+def _emit_event_names(ctx: Context) -> list[tuple[str, str, int, bool]]:
+    """(name_or_prefix, path, line, exact) for literal first args of
+    `emit(...)` calls (module fn or any `.emit(` method)."""
+    out = []
+    for sf in ctx.package_sources():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name != "emit":
+                continue
+            s, exact = str_prefix(node.args[0])
+            if s is not None:
+                out.append((s, sf.rel, node.lineno, exact))
+    return out
+
+
+def _metric_tags(ctx: Context) -> list[tuple[str, str, int, bool]]:
+    out = []
+    for sf in ctx.package_sources():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in SINKS_FIRST_ARG:
+                s, exact = str_prefix(node.args[0])
+                if s is not None:
+                    out.append((s, sf.rel, node.lineno, exact))
+            elif name in SINKS_DICT_ARG and isinstance(node.args[0], ast.Dict):
+                for key in node.args[0].keys:
+                    s, exact = str_prefix(key)
+                    if s is not None:
+                        out.append((s, sf.rel, node.lineno, exact))
+    return out
+
+
+def _all_string_literals(ctx: Context) -> set[str]:
+    """Every string constant + f-string leading constant in the package
+    (the lenient liveness oracle for documented metric names)."""
+    out: set[str] = set()
+    for sf in ctx.package_sources():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                out.add(node.value)
+            elif isinstance(node, ast.JoinedStr) and node.values:
+                first = node.values[0]
+                if (isinstance(first, ast.Constant)
+                        and isinstance(first.value, str)):
+                    out.add(first.value + "\x00prefix")
+    return out
+
+
+def _matches_doc(tag: str, exact: bool, doc: dict[str, int]) -> bool:
+    for name in doc:
+        if name.endswith("*"):
+            if tag.startswith(name[:-1]):
+                return True
+        elif exact and tag == name:
+            return True
+        elif not exact and name.startswith(tag):
+            # an f-string prefix like "fleet/latency_ms_" may sit under a
+            # longer documented pattern; accept when either contains the
+            # other up to the wildcard
+            return True
+    return False
+
+
+class JournalDriftRule(Rule):
+    rule_id = "journal-drift"
+    doc = ("journal event names in code vs docs/OBSERVABILITY.md's event "
+           "table (both directions + charset hygiene)")
+
+    def check(self, ctx: Context) -> list[Finding]:
+        text = ctx.read_text(DOC_PATH)
+        if text is None:
+            return [Finding(self.rule_id, DOC_PATH, 1, "doc missing")]
+        documented = _doc_names(text, EVENT_TABLE_HEADER)
+        if not documented:
+            return [Finding(self.rule_id, DOC_PATH, 1,
+                            "could not parse the event table")]
+        out: list[Finding] = []
+        emitted: set[str] = set()
+        for name, path, line, exact in _emit_event_names(ctx):
+            if not exact:
+                continue  # dynamic event names: nothing checkable
+            emitted.add(name)
+            if not TAG_RE.match(name):
+                out.append(Finding(
+                    self.rule_id, path, line,
+                    f"event name {name!r} violates the hygiene charset "
+                    f"^[a-z0-9_/.]+$"))
+            elif name not in documented:
+                out.append(Finding(
+                    self.rule_id, path, line,
+                    f"event {name!r} is emitted here but missing from "
+                    f"{DOC_PATH}'s event table — add a row (event, "
+                    f"emitter, payload)"))
+        for name, lineno in sorted(documented.items()):
+            if name not in emitted:
+                out.append(Finding(
+                    self.rule_id, DOC_PATH, lineno,
+                    f"documented event {name!r} is emitted nowhere in "
+                    f"the package — dead row, or the emitter renamed it"))
+        return out
+
+
+class MetricDriftRule(Rule):
+    rule_id = "metric-drift"
+    doc = ("literal metric tags in code vs docs/OBSERVABILITY.md's "
+           "namespace table (forward: strict; reverse: liveness)")
+
+    def check(self, ctx: Context) -> list[Finding]:
+        text = ctx.read_text(DOC_PATH)
+        if text is None:
+            return [Finding(self.rule_id, DOC_PATH, 1, "doc missing")]
+        documented = _doc_names(text, METRIC_TABLE_HEADER)
+        if not documented:
+            return [Finding(self.rule_id, DOC_PATH, 1,
+                            "could not parse the metric namespace table")]
+        out: list[Finding] = []
+        for tag, path, line, exact in _metric_tags(ctx):
+            if exact and not TAG_RE.match(tag):
+                out.append(Finding(
+                    self.rule_id, path, line,
+                    f"metric tag {tag!r} violates the hygiene charset "
+                    f"^[a-z0-9_/.]+$"))
+            elif not _matches_doc(tag, exact, documented):
+                out.append(Finding(
+                    self.rule_id, path, line,
+                    f"metric tag {tag!r} matches no namespace in "
+                    f"{DOC_PATH}'s table — add it (or its `ns/*` row)"))
+        literals = _all_string_literals(ctx)
+        prefixes = {s[:-len("\x00prefix")] for s in literals
+                    if s.endswith("\x00prefix")}
+        plain = {s for s in literals if not s.endswith("\x00prefix")}
+        for name, lineno in sorted(documented.items()):
+            if name.endswith("*"):
+                stem = name[:-1]
+                live = (any(s.startswith(stem) for s in plain)
+                        or any(p.startswith(stem) or stem.startswith(p)
+                               for p in prefixes if p))
+            else:
+                live = name in plain or any(
+                    name.startswith(p) for p in prefixes if p)
+            if not live:
+                out.append(Finding(
+                    self.rule_id, DOC_PATH, lineno,
+                    f"documented metric {name!r} has no trace in the "
+                    f"package's string literals — dead row?"))
+        return out
+
+
+RULE = JournalDriftRule()
+METRIC_RULE = MetricDriftRule()

@@ -1,0 +1,112 @@
+"""spmd-divergence: collectives under per-process branches deadlock.
+
+The gloo deadlock class PR 8 and PR 10 each hit once: under SPMD every
+process must issue the same collective sequence, so a collective (or a
+multihost orbax save/restore, which runs its own barrier collectives)
+lexically nested under an ``if jax.process_index() == 0:`` /
+``is_chief()`` / host-id / rank conditional hangs every OTHER process in
+the collective until the heartbeat timeout. The classic shape:
+
+    if jax.process_index() == 0:
+        state = broadcast_one_to_all(state)   # only rank 0 arrives
+
+The check is lexical on purpose: an early-``return`` guard
+(``if process_index() != 0: return``) puts later collectives OUTSIDE the
+``if`` body and is therefore fine, while both the body and the ``else``
+arm of a rank conditional are flagged (one arm issuing a collective the
+other doesn't is the same deadlock).
+
+Checkpoint-manager ``.save``/``.restore`` attribute calls count only
+when the receiver's source mentions a checkpoint-ish name — plain
+``writer.save(...)`` on a rank guard is the chief-writes-summaries
+pattern and is legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dist_mnist_tpu.analysis.core import (
+    Context, Finding, Rule, SourceFile, call_name, node_source)
+
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "shard_map",
+    "broadcast_one_to_all", "process_allgather", "sync_global_devices",
+    "assert_equal",
+})
+#: attribute calls that are collective-bearing only on checkpoint-ish
+#: receivers (orbax managers run barrier collectives internally)
+CKPT_METHODS = frozenset({"save", "restore", "wait_until_finished"})
+CKPT_RECEIVER_HINTS = ("ckpt", "checkpoint", "manager", "mngr", "orbax",
+                       "snapshot")
+RANK_MARKERS = ("process_index", "process_id", "host_id", "is_chief",
+                "task_index", "rank")
+
+
+def _is_rank_conditional(sf: SourceFile, test: ast.AST) -> bool:
+    src = node_source(sf, test)
+    return any(m in src for m in RANK_MARKERS)
+
+
+def _collective_desc(sf: SourceFile, call: ast.Call) -> str | None:
+    name, is_method = call_name(call)
+    if name in COLLECTIVES:
+        return f"{name}()"
+    if name in CKPT_METHODS and is_method:
+        recv = node_source(sf, call.func.value).lower()
+        if any(h in recv for h in CKPT_RECEIVER_HINTS):
+            return f"checkpoint {name}() (internal barrier collectives)"
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.stack: list[ast.If] = []
+        self.findings: list[Finding] = []
+
+    def visit_If(self, node: ast.If) -> None:
+        ranked = _is_rank_conditional(self.sf, node.test)
+        if ranked:
+            self.stack.append(node)
+        self.generic_visit(node)
+        if ranked:
+            self.stack.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.stack:
+            desc = _collective_desc(self.sf, node)
+            if desc is not None:
+                guard = node_source(self.sf, self.stack[-1].test)
+                guard = " ".join(guard.split())[:60]
+                self.findings.append(self.sf.finding(
+                    "spmd-divergence", node,
+                    f"{desc} under per-process branch `if {guard}:` — "
+                    f"ranks that skip the branch never join the "
+                    f"collective (deadlock); hoist it or annotate "
+                    f"`# lint: ok[spmd-divergence] <why>`"))
+        self.generic_visit(node)
+
+
+def scan_source(sf: SourceFile) -> list[Finding]:
+    if sf.tree is None:
+        return []
+    v = _Visitor(sf)
+    v.visit(sf.tree)
+    return v.findings
+
+
+class SpmdDivergenceRule(Rule):
+    rule_id = "spmd-divergence"
+    doc = ("collectives / multihost checkpoint IO lexically nested under "
+           "process_index()/host-id/rank conditionals")
+
+    def check(self, ctx: Context) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in ctx.package_sources():
+            out.extend(scan_source(sf))
+        return out
+
+
+RULE = SpmdDivergenceRule()

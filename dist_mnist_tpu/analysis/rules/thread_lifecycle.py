@@ -1,0 +1,240 @@
+"""thread-lifecycle: every background thread is named, registered, and
+has a shutdown path.
+
+The conftest leak-check (tests/conftest.py `_no_leaked_prefetch_workers`)
+is prefix-based: it can only catch a leaked thread whose name starts with
+a registered prefix. A `threading.Thread(...)` created with no name (or
+an unregistered one) is invisible to it — the exact blind spot every new
+subsystem re-creates. Three checks per instantiation in the package:
+
+1. **named** — the constructor passes ``name=`` with a resolvable
+   literal prefix (a plain string, an f-string's leading constant, a
+   module-level ``THREAD_NAME_PREFIX``, or a parameter's string
+   default).
+2. **registered** — that prefix matches one of the ``startswith(...)``
+   prefixes the conftest leak-check polls for.
+3. **joinable** — the enclosing class has a shutdown-shaped method
+   (close/stop/shutdown/drain/wait/join/__exit__), or, for threads built
+   outside a class, the enclosing function joins a thread itself.
+
+The registry is parsed FROM tests/conftest.py, so adding a prefix there
+is the single source of truth — this rule can never drift from what the
+leak-check actually polices.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dist_mnist_tpu.analysis.core import (
+    Context, Finding, Rule, SourceFile, const_str)
+
+CONFTEST_PATH = "tests/conftest.py"
+SHUTDOWN_METHODS = frozenset({
+    "close", "stop", "shutdown", "drain", "wait", "join", "__exit__",
+})
+#: data/prefetch.py exports the prefix conftest imports; resolve both ends
+PREFIX_VAR = "THREAD_NAME_PREFIX"
+
+
+def conftest_prefixes(ctx: Context) -> set[str]:
+    """Every literal prefix the leak-check polls via `startswith`, plus
+    the resolved THREAD_NAME_PREFIX constants it imports."""
+    prefixes: set[str] = set()
+    sf = ctx.source(CONFTEST_PATH)
+    if sf is None or sf.tree is None:
+        return prefixes
+    for node in ast.walk(sf.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "startswith"):
+            for arg in node.args:
+                s = const_str(arg)
+                if s:
+                    prefixes.add(s)
+    # conftest imports data.prefetch's THREAD_NAME_PREFIX; the snapshot
+    # writer defines its own — both are registered via their values
+    for rel in ("dist_mnist_tpu/data/prefetch.py",
+                "dist_mnist_tpu/checkpoint/snapshot.py"):
+        val = _module_prefix_value(ctx.source(rel))
+        if val:
+            prefixes.add(val)
+    return prefixes
+
+
+def _module_prefix_value(sf: SourceFile | None) -> str | None:
+    if sf is None or sf.tree is None:
+        return None
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == PREFIX_VAR:
+                    return const_str(node.value)
+    return None
+
+
+def _resolve_name(sf: SourceFile, call: ast.Call,
+                  enclosing: list[ast.AST]) -> str | None:
+    """Best-effort literal prefix of the `name=` kwarg."""
+    name_kw = next((kw.value for kw in call.keywords if kw.arg == "name"),
+                   None)
+    if name_kw is None:
+        return None
+    s = const_str(name_kw)
+    if s is not None:
+        return s
+    if isinstance(name_kw, ast.JoinedStr):
+        parts = []
+        for v in name_kw.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+                continue
+            if (isinstance(v, ast.FormattedValue)
+                    and isinstance(v.value, ast.Name)):
+                resolved = _resolve_variable(sf, v.value.id, enclosing)
+                if resolved is not None:
+                    parts.append(resolved)
+                    continue
+            break  # first unresolvable piece ends the literal prefix
+        return "".join(parts) or None
+    if isinstance(name_kw, ast.Name):
+        return _resolve_variable(sf, name_kw.id, enclosing)
+    return None
+
+
+def _resolve_variable(sf: SourceFile, var: str,
+                      enclosing: list[ast.AST]) -> str | None:
+    """Resolve `var` to a string: module-level assign, or the string
+    default of a parameter of the enclosing function."""
+    if var == PREFIX_VAR:
+        return _module_prefix_value(sf)
+    for node in reversed(enclosing):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            pos = args.posonlyargs + args.args
+            defaults = args.defaults
+            for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+                if a.arg == var:
+                    return const_str(d)
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if a.arg == var and d is not None:
+                    return const_str(d)
+    if sf.tree is not None:
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == var:
+                        return const_str(node.value)
+    return None
+
+
+def _has_shutdown_path(enclosing: list[ast.AST]) -> bool:
+    # a thread built inside a method belongs to the CLASS's lifecycle:
+    # prefer the nearest enclosing ClassDef over the method itself
+    for node in reversed(enclosing):
+        if isinstance(node, ast.ClassDef):
+            return any(
+                isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and m.name in SHUTDOWN_METHODS
+                for m in node.body)
+    for node in reversed(enclosing):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # function-local thread: require a .join( somewhere in it
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "join"):
+                    return True
+            return False
+    return False
+
+
+def _thread_calls(sf: SourceFile):
+    """Yield (call, enclosing_stack) for threading.Thread(...) /
+    Thread(...) instantiations."""
+    if sf.tree is None:
+        return
+
+    stack: list[ast.AST] = []
+
+    def walk(node: ast.AST):
+        is_scope = isinstance(
+            node, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef))
+        if is_scope:
+            stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                fn = child.func
+                if ((isinstance(fn, ast.Attribute) and fn.attr == "Thread")
+                        or (isinstance(fn, ast.Name)
+                            and fn.id == "Thread")):
+                    yield child, list(stack)
+            yield from walk(child)
+        if is_scope:
+            stack.pop()
+
+    yield from walk(sf.tree)
+
+
+def _thread_subclasses(sf: SourceFile):
+    if sf.tree is None:
+        return
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            for base in node.bases:
+                base_name = (base.attr if isinstance(base, ast.Attribute)
+                             else base.id if isinstance(base, ast.Name)
+                             else None)
+                if base_name == "Thread":
+                    yield node
+
+
+def scan_source(sf: SourceFile, prefixes: set[str]) -> list[Finding]:
+    out = []
+    for cls in _thread_subclasses(sf):
+        if not any(isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and m.name in SHUTDOWN_METHODS for m in cls.body):
+            out.append(sf.finding(
+                "thread-lifecycle", cls,
+                f"threading.Thread subclass {cls.name} defines no "
+                f"close/stop/shutdown/drain/wait/join method"))
+    for call, enclosing in _thread_calls(sf):
+        name = _resolve_name(sf, call, enclosing)
+        if name is None:
+            out.append(sf.finding(
+                "thread-lifecycle", call,
+                "thread has no resolvable literal `name=` — the conftest "
+                "leak-check is prefix-based and cannot see unnamed "
+                "threads; name it with a registered prefix"))
+        elif not any(name.startswith(p) for p in prefixes):
+            out.append(sf.finding(
+                "thread-lifecycle", call,
+                f"thread name {name!r} matches no prefix polled by "
+                f"{CONFTEST_PATH}'s leak-check — a leak here is "
+                f"invisible to tier-1; register the prefix there"))
+        if not _has_shutdown_path(enclosing):
+            out.append(sf.finding(
+                "thread-lifecycle", call,
+                "no shutdown path: the enclosing class has no "
+                "close/stop/shutdown/drain/wait/join method and the "
+                "enclosing function never joins a thread"))
+    return out
+
+
+class ThreadLifecycleRule(Rule):
+    rule_id = "thread-lifecycle"
+    doc = ("background threads must carry a conftest-registered name "
+           "prefix and a close/join path")
+
+    def check(self, ctx: Context) -> list[Finding]:
+        prefixes = conftest_prefixes(ctx)
+        if not prefixes:
+            return [Finding(self.rule_id, CONFTEST_PATH, 1,
+                            "could not parse any leak-check prefixes")]
+        out: list[Finding] = []
+        for sf in ctx.package_sources():
+            out.extend(scan_source(sf, prefixes))
+        return out
+
+
+RULE = ThreadLifecycleRule()

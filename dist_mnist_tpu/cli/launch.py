@@ -259,7 +259,8 @@ def _launch_once(
             procs.append(p)
             _LIVE_CHILDREN.append(p)
             t = threading.Thread(
-                target=_pump, args=(p, f"p{hosts[i]}"), daemon=True
+                target=_pump, args=(p, f"p{hosts[i]}"),
+                name=f"LaunchPump-p{hosts[i]}", daemon=True
             )
             t.start()
             pumps.append(t)

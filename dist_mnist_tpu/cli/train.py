@@ -271,6 +271,19 @@ def compile_cache_key_fields(cfg, mesh, *, scan_chunk=0,
         "scan_chunk": scan_chunk,
         "input_pipeline": input_pipeline,
         "prng": cfg.prng_impl,
+        # the optimizer chain closes over these as Python scalars, so they
+        # are constant-folded into the jitted update: a cached executable
+        # from a different schedule/regularization would train wrong —
+        # silently. Likewise dataset (input shapes) and
+        # replicas_to_aggregate (accumulation loop structure).
+        "dataset": cfg.dataset,
+        "train_steps": cfg.train_steps,
+        "learning_rate": cfg.learning_rate,
+        "lr_schedule": cfg.lr_schedule,
+        "warmup_steps": cfg.warmup_steps,
+        "replicas_to_aggregate": cfg.replicas_to_aggregate,
+        "grad_clip_norm": cfg.grad_clip_norm,
+        "weight_decay": cfg.weight_decay,
     }
     if quant and quant != "none":
         fields["quant"] = quant

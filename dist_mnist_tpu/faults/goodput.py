@@ -289,7 +289,7 @@ def elastic_summary(records) -> dict:
             and r.get("process", 0) == 0
             and isinstance(r.get("goodput"), dict)
         ):
-            busy += float(r["goodput"].get("productive_s", 0.0))  # host-sync-ok: parses a journal JSON float, no device value
+            busy += float(r["goodput"].get("productive_s", 0.0))  # lint: ok[host-sync] parses a journal JSON float, no device value
             if r.get("step") is not None:
                 final_step = r["step"]
 

@@ -221,8 +221,8 @@ class LoggingHook(Hook):
         # blocking sync per metric per cadence, serializing dispatch
         wanted = {k: outputs[k] for k in keys
                   if k in outputs and getattr(outputs[k], "size", 1) == 1}
-        vals = jax.device_get(wanted)  # host-sync-ok: one batched fetch per cadence
-        parts = [f"{k}={float(v):.4f}" for k, v in vals.items()]  # host-sync-ok: numpy scalars post-fetch
+        vals = jax.device_get(wanted)  # lint: ok[host-sync] one batched fetch per cadence
+        parts = [f"{k}={float(v):.4f}" for k, v in vals.items()]  # lint: ok[host-sync] numpy scalars post-fetch
         log.info("step %d: %s", step, ", ".join(parts))
 
 
@@ -249,7 +249,7 @@ class NaNGuardHook(Hook):
         self._timer.mark()
         # explicit single fetch (float() on a device scalar is an implicit
         # blocking sync; keep the sync surface to one call per cadence)
-        val = float(jax.device_get(outputs[self._key]))  # host-sync-ok: one scalar per cadence, NaN check NEEDS the value
+        val = float(jax.device_get(outputs[self._key]))  # lint: ok[host-sync] one scalar per cadence, NaN check NEEDS the value
         if math.isfinite(val):
             return
         if self._fail:
@@ -359,14 +359,14 @@ class SummaryHook(Hook):
         # ONE device_get for the whole cadence — histograms AND scalars.
         # The per-key `float(v)` here was one blocking sync per metric per
         # cadence (the same serialized-dispatch bug LoggingHook fixed).
-        fetched = jax.device_get(dict(outputs))  # host-sync-ok: one batched fetch per cadence
+        fetched = jax.device_get(dict(outputs))  # lint: ok[host-sync] one batched fetch per cadence
         vals = {}
         for k, v in fetched.items():
             if getattr(v, "size", 1) > 1:
                 self._write_histogram(k, v, step)
                 continue
             try:
-                vals[k] = float(v)  # host-sync-ok: numpy scalar post-fetch
+                vals[k] = float(v)  # lint: ok[host-sync] numpy scalar post-fetch
             except (TypeError, ValueError):
                 pass
         batch_write = getattr(self._writer, "scalars", None)
@@ -393,7 +393,7 @@ class SummaryHook(Hook):
         flat, _, paths = _paths(state.params)
         wanted = {p: leaf for p, (_, leaf) in zip(paths, flat)
                   if getattr(leaf, "size", 0)}
-        fetched = jax.device_get(wanted)  # host-sync-ok: one batched pull per (slow) param-histogram cadence
+        fetched = jax.device_get(wanted)  # lint: ok[host-sync] one batched pull per (slow) param-histogram cadence
         for path, vals in fetched.items():
             self._write_histogram(f"params/{path}", vals, step)
 

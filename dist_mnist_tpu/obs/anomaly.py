@@ -151,7 +151,7 @@ class AnomalyHook:
             import jax  # lazy: keep obs.anomaly importable without jax
 
             # the NaNGuardHook budget: ONE scalar fetch per cadence
-            val = float(jax.device_get(outputs[self._key]))  # host-sync-ok: one scalar per cadence, the detector NEEDS the value
+            val = float(jax.device_get(outputs[self._key]))  # lint: ok[host-sync] one scalar per cadence, the detector NEEDS the value
             v = self._loss_det.check(val)
             self.last["loss"] = val
             if v is not None and v["anomaly"]:

@@ -247,7 +247,7 @@ def error_report(float_tree, quant_tree) -> dict:
               "n_quantized": len(names)}
     if not names:
         return report
-    # host-sync-ok: ONE batched pull of all per-leaf maxima, at load time
+    # lint: ok[host-sync] ONE batched pull of all per-leaf maxima, at load time
     vals = np.asarray(jax.device_get(jnp.stack(stats))).tolist()
     for name, mode, (err, ref) in zip(names, modes, vals):
         rel = err / max(ref, _EPS)

@@ -118,10 +118,12 @@ def run_demo(
                 break
 
     threads = [
+        # lint: ok[thread-lifecycle] demo-scoped workers, joined below in this function
         threading.Thread(target=worker, args=(w,), daemon=True)
         for w in range(num_workers)
     ]
     if mode == "sync":
+        # lint: ok[thread-lifecycle] demo-scoped chief, joined below in this function
         threads.append(threading.Thread(target=chief, daemon=True))
     t0 = time.monotonic()
     for t in threads:

@@ -54,7 +54,7 @@ class DynamicBatcher:
         self.max_wait = max_wait_ms / 1e3
         self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._loop, name="serve-batcher", daemon=True
+            target=self._loop, name="ServeBatcher", daemon=True
         )
 
     def start(self) -> None:

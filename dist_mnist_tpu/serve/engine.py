@@ -658,11 +658,11 @@ class InferenceEngine:
             self.seq_bucket_counts.get(h_bucket, 0) + 1
         with stopclock(self.cache.times, "execute"):
             # THE batched logits pull — the one intentional
-            # host-sync-ok: sync per executed batch (stop-clock discipline)
+            # lint: ok[host-sync] sync per executed batch (stop-clock discipline)
             out = jax.device_get(exe(self.params, self.model_state, *args))
         if self._moe:
             logits, drop = out
-            # host-sync-ok: `drop` arrived in the device_get above
+            # lint: ok[host-sync] `drop` arrived in the device_get above
             self.last_moe_drop_fraction = float(drop)
         else:
             logits = out

@@ -231,7 +231,7 @@ def build_zoo_engine(
         # weights serve unchanged under the new capacity
         model = dataclasses.replace(
             model,
-            moe_capacity_factor=float(  # host-sync-ok: CLI scalar, no device
+            moe_capacity_factor=float(  # lint: ok[host-sync] CLI scalar, no device
                 moe_capacity_factor))
 
     grid = seq_buckets

@@ -28,7 +28,7 @@ class TrainState:
     @property
     def step_int(self) -> int:
         # every caller is a cold path (checkpoint save, restore seek, log)
-        # host-sync-ok: one explicit scalar fetch on those cold paths
+        # lint: ok[host-sync] one explicit scalar fetch on those cold paths
         return int(jax.device_get(self.step))
 
 

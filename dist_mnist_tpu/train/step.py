@@ -548,10 +548,10 @@ def evaluate(eval_step, state, images, labels, mesh: Mesh, batch_size: int = 100
         totals = part if totals is None else tuple(
             t + p for t, p in zip(totals, part)
         )
-    # host-sync-ok: the ONE batched end-of-eval fetch the docstring promises
+    # lint: ok[host-sync] the ONE batched end-of-eval fetch the docstring promises
     total_loss, total_correct, total_n = jax.device_get(totals)
     return {
-        "loss": float(total_loss) / int(total_n),  # host-sync-ok: numpy scalar math post-fetch
+        "loss": float(total_loss) / int(total_n),  # lint: ok[host-sync] numpy scalar math post-fetch
         "accuracy": int(total_correct) / int(total_n),
         "n": int(total_n),
     }

@@ -1,120 +1,64 @@
 #!/usr/bin/env python
 """Grep-lint for accidental host synchronization in hot-path modules.
 
-The per-step dispatch pipeline is this framework's whole perf story: a
-single stray `float(device_scalar)` / `.item()` / per-key `device_get`
-inside the train loop, the prefetch worker, or a hook's cadence path
-serializes dispatch exactly the way the reference's per-step feed_dict
-round-trip did (SURVEY.md §3.3) — and it regresses silently, because the
-numbers stay correct. This lint makes the sync surface explicit:
+Since ISSUE 15 this is a THIN SHIM over the graftlint rule
+`dist_mnist_tpu.analysis.rules.host_sync` — one implementation, two
+front doors. The full suite (`python -m dist_mnist_tpu.analysis`) runs
+this rule alongside the others; this script keeps the original CLI and
+exit codes so existing muscle memory, docs, and
+tests/test_host_sync_lint.py all keep working:
 
-- Scanned modules (the hot paths): ``dist_mnist_tpu/train/``,
-  ``dist_mnist_tpu/faults/``, ``dist_mnist_tpu/data/prefetch.py``,
-  ``dist_mnist_tpu/hooks/builtin.py``.
-- Flagged constructs: ``float(`` and ``device_get(`` calls, and ``.item()``
-  — each an implicit (or explicit) device->host blocking transfer when its
-  operand is a device array.
-- Allowlist: a ``host-sync-ok`` comment on the same line or the line above
-  marks an INTENTIONAL sync (e.g. LoggingHook's one batched fetch per
-  cadence, evaluate()'s single end-of-eval pull). The comment is the
-  reviewable artifact: every sync in a hot path is either justified in
-  place or a lint failure.
-
-Tokenizer-based, not regex-on-lines: occurrences inside comments and
-docstrings don't count (several hot-path docstrings MENTION `float()`
-while explaining why it was removed).
+- Scanned modules: the curated hot-path set, now owned by the rule as
+  `host_sync.HOT_PATH_TARGETS` (train/, faults/, the prefetch worker,
+  hook cadence paths, the overlap schedule, serve dispatch/load paths).
+- Flagged constructs: bare ``float(``, ``.item()`` methods, bare or
+  qualified ``device_get(`` — each a blocking device->host transfer
+  when its operand is a device array. AST-scoped: only code inside
+  function/lambda bodies counts (module level runs once at import).
+- Allowlist: ``# lint: ok[host-sync] <why>`` on the same line or the
+  line above; the legacy ``# host-sync-ok: <why>`` marker is still
+  honored. The comment is the reviewable artifact: every sync in a hot
+  path is either justified in place or a lint failure.
 
 Exit status: 0 clean, 1 violations (printed one per line as
 ``path:lineno: message``). Wired into tier-1 via
-tests/test_host_sync_lint.py.
+tests/test_host_sync_lint.py; the whole-suite wiring lives in
+tests/test_analysis.py.
 """
 
 from __future__ import annotations
 
-import io
 import sys
-import tokenize
 from pathlib import Path
 
-ALLOW_MARKER = "host-sync-ok"
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:  # script-run without an install
+    sys.path.insert(0, str(_REPO_ROOT))
 
-#: NAME tokens that, followed by "(", count as a sync call whether bare or
-#: attribute-qualified (`jax.device_get(...)`).
-ANY_NAMES = ("device_get",)
+from dist_mnist_tpu.analysis.core import SourceFile  # noqa: E402
+from dist_mnist_tpu.analysis.rules import host_sync  # noqa: E402
 
-#: NAME tokens that count only when BARE (not `x.float(...)`).
-BARE_NAMES = ("float",)
+ALLOW_MARKER = "host-sync-ok"  # legacy marker, still honored
 
-#: NAME tokens that count only as a METHOD call: preceded by "." and
-#: followed by "(" — bare `item(` is some other function.
-METHOD_NAMES = ("item",)
+# re-exported so the construct lists live in exactly one place
+ANY_NAMES = host_sync.ANY_NAMES
+BARE_NAMES = host_sync.BARE_NAMES
+METHOD_NAMES = host_sync.METHOD_NAMES
 
 
 def default_targets(repo_root: Path) -> list[Path]:
-    pkg = repo_root / "dist_mnist_tpu"
-    targets = sorted((pkg / "train").glob("*.py"))
-    # faults/ sits inside the loop (injection hook per step, goodput clock
-    # per iteration) — same hot-path rules apply
-    targets += sorted((pkg / "faults").glob("*.py"))
-    # parallel/overlap.py builds the comm/compute-overlap prefetch path —
-    # one host sync there serializes exactly what it exists to overlap
-    targets += [pkg / "data" / "prefetch.py", pkg / "hooks" / "builtin.py",
-                pkg / "parallel" / "overlap.py"]
-    # serve/zoo.py is the zoo's PLANNING layer: grid/mask/byte accounting
-    # must stay metadata-only — every device transfer belongs in engine.py
-    targets += [pkg / "serve" / "zoo.py"]
-    # the quantized-serving path: ops/quant.py's quantize pass must stay
-    # free of hot-path syncs (its one batched error-report pull and the
-    # load-time degenerate-scale check are the annotated exceptions), and
-    # engine.py/loader.py carry the per-request dispatch + load paths the
-    # quant work rides through
-    targets += [pkg / "ops" / "quant.py", pkg / "serve" / "engine.py",
-                pkg / "serve" / "loader.py"]
-    return [t for t in targets if t.exists()]
+    return host_sync.hot_path_files(Path(repo_root))
 
 
 def scan_file(path: Path) -> list[tuple[int, str]]:
-    """(lineno, message) per violation in `path`."""
-    src = path.read_text()
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
-    except tokenize.TokenError as err:
-        return [(1, f"unparseable: {err}")]
-
-    # lines carrying an allowlist comment bless themselves AND the line
-    # below (marker-above style for lines that would overflow)
-    allowed: set[int] = set()
-    for tok in tokens:
-        if tok.type == tokenize.COMMENT and ALLOW_MARKER in tok.string:
-            allowed.add(tok.start[0])
-            allowed.add(tok.start[0] + 1)
-
-    out = []
-    # meaningful tokens only: NL/INDENT/COMMENT tokens between a name and
-    # its "(" would defeat the adjacency check
-    code = [t for t in tokens
-            if t.type in (tokenize.NAME, tokenize.OP, tokenize.NUMBER,
-                          tokenize.STRING)]
-    for i, tok in enumerate(code):
-        if tok.type != tokenize.NAME:
-            continue
-        nxt = code[i + 1] if i + 1 < len(code) else None
-        if nxt is None or nxt.string != "(":
-            continue
-        prev = code[i - 1] if i > 0 else None
-        is_method = prev is not None and prev.string == "."
-        if (tok.string in ANY_NAMES
-                or tok.string in BARE_NAMES and not is_method
-                or tok.string in METHOD_NAMES and is_method):
-            if tok.start[0] in allowed:
-                continue
-            what = f".{tok.string}()" if is_method else f"{tok.string}("
-            out.append((
-                tok.start[0],
-                f"{what} in a hot-path module is a blocking device->host "
-                f"sync; batch it or annotate with `# {ALLOW_MARKER}: <why>`",
-            ))
-    return out
+    """(lineno, message) per violation in `path`. Suppressions (both
+    marker forms) are applied here — standalone files never pass through
+    the engine's suppression stage."""
+    path = Path(path)
+    sf = SourceFile(path, str(path))
+    return [(f.line, f.message)
+            for f in host_sync.scan_source(sf)
+            if not sf.is_suppressed("host-sync", f.line)]
 
 
 def main(argv: list[str]) -> int:

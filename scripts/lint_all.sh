@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One-stop pre-commit gate: the source lints + the measured-numbers gate.
+#
+#   scripts/lint_all.sh                 # full tree
+#   scripts/lint_all.sh --changed-only  # graftlint scoped to git-dirty files
+#
+# 1. graftlint (python -m dist_mnist_tpu.analysis): AST rules for
+#    trace-safety, SPMD divergence, cache-key completeness, thread
+#    lifecycle, journal/metric registry drift, bench-stage wiring
+#    (docs/ANALYSIS.md). Extra args are passed straight through.
+# 2. scripts/check_bench_regression.py: newest BENCH_*.json vs
+#    docs/PERF_ANCHOR.json (skips cleanly when no bench artifact or no
+#    accelerator is reachable — it gates measurement-day commits, not
+#    every edit).
+#
+# Exit: nonzero if any gate fails.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== graftlint (python -m dist_mnist_tpu.analysis $*)"
+python -m dist_mnist_tpu.analysis "$@" || rc=1
+
+echo "== bench regression gate (scripts/check_bench_regression.py)"
+python scripts/check_bench_regression.py || rc=1
+
+if [ "$rc" -eq 0 ]; then
+    echo "lint_all: all gates clean"
+else
+    echo "lint_all: FAILURES above" >&2
+fi
+exit "$rc"

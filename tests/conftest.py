@@ -105,6 +105,8 @@ def _no_leaked_prefetch_workers():
                        or t.name.startswith("SnapshotWriter")
                        or t.name.startswith("ObsExporter")
                        or t.name.startswith("ZooPrewarm")
+                       or t.name.startswith("ServeBatcher")
+                       or t.name.startswith("LaunchPump")
                        or t.name.startswith("Router"))]
         exporter_mod = sys.modules.get("dist_mnist_tpu.obs.exporter")
         if exporter_mod is not None:

@@ -1,0 +1,523 @@
+"""graftlint (dist_mnist_tpu.analysis) — ISSUE 15 tentpole wiring.
+
+Three layers, all jax-free (the analysis package is stdlib-only by
+design, so this file keeps tier-1's no-accelerator property):
+
+1. per-rule regression pairs — for every rule, a violating fixture that
+   MUST produce its finding (the true-positive regression test) and a
+   clean twin that must not;
+2. the engine contracts — suppression grammar (unified + legacy forms,
+   own-line + line-above, multi-rule, reasonless = finding), baseline
+   round-trip (match, partition, stale, empty-reason hard error), JSON
+   schema;
+3. the meta-test: `python -m dist_mnist_tpu.analysis` on THIS tree exits
+   0 — the lint suite is a tier-1 invariant from here on.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from dist_mnist_tpu.analysis import baseline as baseline_mod
+from dist_mnist_tpu.analysis import rules as rules_mod
+from dist_mnist_tpu.analysis.core import Context, Finding, SourceFile, run
+from dist_mnist_tpu.analysis.rules import (
+    bench_stages, host_sync, registry_drift, spmd_divergence,
+    thread_lifecycle)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def sf_of(tmp_path: Path, text: str, name: str = "mod.py") -> SourceFile:
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(text))
+    return SourceFile(p, name)
+
+
+def repo_of(tmp_path: Path, files: dict[str, str]) -> Context:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return Context(tmp_path)
+
+
+# -- registry -----------------------------------------------------------------
+
+def test_at_least_six_rules_registered():
+    assert len(rules_mod.ALL_RULES) >= 6
+    assert len(set(rules_mod.RULE_IDS)) == len(rules_mod.ALL_RULES)
+
+
+def test_select_unknown_rule_raises():
+    with pytest.raises(KeyError):
+        rules_mod.select(["no-such-rule"])
+
+
+# -- host-sync ----------------------------------------------------------------
+
+def test_host_sync_flags_in_function_syncs(tmp_path):
+    sf = sf_of(tmp_path, """\
+        import jax
+        def step(x, arr):
+            a = float(x)
+            b = jax.device_get(x)
+            c = arr.item()
+            return a, b, c
+        """)
+    lines = [f.line for f in host_sync.scan_source(sf)]
+    assert lines == [3, 4, 5]
+
+
+def test_host_sync_module_level_is_import_time_not_hot_path(tmp_path):
+    # the AST rule's improvement over the tokenize lint: module-level
+    # calls run once at import, never per step
+    sf = sf_of(tmp_path, """\
+        import jax
+        EPS = float("1e-8")
+        def step(x):
+            return x
+        """)
+    assert host_sync.scan_source(sf) == []
+
+
+def test_host_sync_hot_path_set_is_nonempty_and_curated():
+    files = host_sync.hot_path_files(REPO_ROOT)
+    names = {p.name for p in files}
+    assert {"step.py", "state.py", "prefetch.py", "builtin.py"} <= names
+
+
+# -- spmd-divergence ----------------------------------------------------------
+
+def test_spmd_flags_collective_under_rank_branch(tmp_path):
+    sf = sf_of(tmp_path, """\
+        import jax
+        def sync(state):
+            if jax.process_index() == 0:
+                state = broadcast_one_to_all(state)
+            return state
+        """)
+    finds = spmd_divergence.scan_source(sf)
+    assert len(finds) == 1 and finds[0].line == 4
+    assert "deadlock" in finds[0].message
+
+
+def test_spmd_flags_ckpt_save_under_rank_branch_but_not_writer(tmp_path):
+    sf = sf_of(tmp_path, """\
+        def save(ckpt_manager, writer, step, state):
+            if jax.process_index() == 0:
+                writer.save(step)            # chief-writes-summaries: legal
+                ckpt_manager.save(step, state)   # orbax barrier: deadlock
+        """)
+    finds = spmd_divergence.scan_source(sf)
+    assert [f.line for f in finds] == [4]
+
+
+def test_spmd_early_return_guard_is_clean(tmp_path):
+    # the guard puts the collective OUTSIDE the if body — every rank
+    # that reaches it participates
+    sf = sf_of(tmp_path, """\
+        def sync(state):
+            if jax.process_index() != 0:
+                return state
+            return broadcast_one_to_all(state)
+        """)
+    assert spmd_divergence.scan_source(sf) == []
+
+
+def test_spmd_else_arm_of_rank_branch_is_flagged(tmp_path):
+    sf = sf_of(tmp_path, """\
+        def sync(x):
+            if jax.process_index() == 0:
+                pass
+            else:
+                x = psum(x, "i")
+            return x
+        """)
+    assert [f.line for f in spmd_divergence.scan_source(sf)] == [5]
+
+
+# -- cache-key ----------------------------------------------------------------
+
+def _cache_key_repo(tmp_path, keyed: str) -> Context:
+    from dist_mnist_tpu.analysis.rules.cache_key import RUNTIME_ONLY
+    configs = "\n".join(
+        ["import dataclasses",
+         "@dataclasses.dataclass(frozen=True)",
+         "class Config:",
+         '    model: str = "mlp"',
+         "    lr_gamma: float = 0.9"]
+        + [f"    {name}: int = 0" for name in sorted(RUNTIME_ONLY)]) + "\n"
+    return repo_of(tmp_path, {
+        "dist_mnist_tpu/configs.py": configs,
+        "dist_mnist_tpu/cli/train.py": (
+            "def compile_cache_key_fields(cfg, mesh):\n"
+            f"    return {keyed}\n"),
+    })
+
+
+def test_cache_key_flags_unkeyed_unallowlisted_field(tmp_path):
+    ctx = _cache_key_repo(tmp_path, '{"model": cfg.model}')
+    finds = rules_mod.select(["cache-key"])[0].check(ctx)
+    assert any("Config.lr_gamma" in f.message for f in finds)
+    assert not any("Config.model" in f.message for f in finds)
+
+
+def test_cache_key_clean_when_all_fields_keyed_or_allowlisted(tmp_path):
+    ctx = _cache_key_repo(
+        tmp_path, '{"model": cfg.model, "lr_gamma": cfg.lr_gamma}')
+    assert rules_mod.select(["cache-key"])[0].check(ctx) == []
+
+
+def test_cache_key_reports_stale_allowlist_entry(tmp_path):
+    # a repo whose Config lost a field the allowlist still names
+    ctx = repo_of(tmp_path, {
+        "dist_mnist_tpu/configs.py": """\
+            import dataclasses
+            @dataclasses.dataclass(frozen=True)
+            class Config:
+                model: str = "mlp"
+            """,
+        "dist_mnist_tpu/cli/train.py": """\
+            def compile_cache_key_fields(cfg, mesh):
+                return {"model": cfg.model}
+            """,
+    })
+    finds = rules_mod.select(["cache-key"])[0].check(ctx)
+    assert any("no longer a Config field" in f.message for f in finds)
+
+
+# -- thread-lifecycle ---------------------------------------------------------
+
+def test_thread_lifecycle_flags_unnamed_and_unregistered(tmp_path):
+    sf = sf_of(tmp_path, """\
+        import threading
+        def spawn():
+            t = threading.Thread(target=print, daemon=True)
+            u = threading.Thread(target=print, name="Mystery-1")
+            t.start(); u.start()
+        """)
+    finds = thread_lifecycle.scan_source(sf, prefixes={"Worker"})
+    msgs = [f.message for f in finds]
+    assert any("no resolvable literal" in m for m in msgs)
+    assert any("'Mystery-1'" in m and "no prefix" in m for m in msgs)
+    # neither thread has a join in the enclosing function
+    assert any("no shutdown path" in m for m in msgs)
+
+
+def test_thread_lifecycle_clean_class_with_close(tmp_path):
+    sf = sf_of(tmp_path, """\
+        import threading
+        class Pump:
+            def __init__(self):
+                self._t = threading.Thread(
+                    target=self._loop, name="Worker-pump", daemon=True)
+            def _loop(self): pass
+            def close(self):
+                self._t.join()
+        """)
+    assert thread_lifecycle.scan_source(sf, prefixes={"Worker"}) == []
+
+
+def test_thread_lifecycle_function_local_join_is_a_shutdown_path(tmp_path):
+    sf = sf_of(tmp_path, """\
+        import threading
+        def run():
+            t = threading.Thread(target=print, name="Worker-tmp")
+            t.start()
+            t.join()
+        """)
+    assert thread_lifecycle.scan_source(sf, prefixes={"Worker"}) == []
+
+
+def test_thread_lifecycle_flags_subclass_without_shutdown(tmp_path):
+    sf = sf_of(tmp_path, """\
+        import threading
+        class Looper(threading.Thread):
+            def run(self): pass
+        class Good(threading.Thread):
+            def run(self): pass
+            def stop(self): pass
+        """)
+    finds = thread_lifecycle.scan_source(sf, prefixes={"Worker"})
+    assert len(finds) == 1 and "Looper" in finds[0].message
+
+
+def test_thread_lifecycle_conftest_registry_parses():
+    prefixes = thread_lifecycle.conftest_prefixes(Context(REPO_ROOT))
+    # the live registry: the rule reads tests/conftest.py, so a prefix
+    # removed there fails HERE, not silently in the leak-check
+    assert {"DevicePrefetcher", "SnapshotWriter", "ServeBatcher",
+            "LaunchPump", "Router"} <= prefixes
+
+
+# -- journal-drift / metric-drift ---------------------------------------------
+
+_DOC = """\
+    ## Metrics
+
+    | namespace | source | highlights |
+    |---|---|---|
+    | `train/*` | loop | step timings |
+    | `dead/metric` | nobody | stale row |
+
+    ## Events
+
+    | event | emitter | payload |
+    |---|---|---|
+    | `good_event` | mod.py | step |
+    | `dead_event` | nobody | stale row |
+    """
+
+
+def _drift_repo(tmp_path, body: str) -> Context:
+    return repo_of(tmp_path, {
+        "docs/OBSERVABILITY.md": _DOC,
+        "dist_mnist_tpu/mod.py": body,
+    })
+
+
+def test_journal_drift_both_directions_and_hygiene(tmp_path):
+    ctx = _drift_repo(tmp_path, """\
+        def f(events, step):
+            events.emit("good_event", step=step)
+            events.emit("rogue_event", step=step)
+            events.emit("Bad-Charset")
+        """)
+    finds = registry_drift.RULE.check(ctx)
+    msgs = "\n".join(f.message for f in finds)
+    assert "'rogue_event' is emitted here but missing" in msgs
+    assert "'dead_event' is emitted nowhere" in msgs
+    assert "'Bad-Charset' violates the hygiene charset" in msgs
+    assert "good_event" not in msgs
+
+
+def test_metric_drift_wildcard_match_and_rogue_tag(tmp_path):
+    ctx = _drift_repo(tmp_path, """\
+        def f(writer, v):
+            writer.scalar("train/loss", v)        # matches train/*
+            writer.scalar("mystery/thing", v)     # undocumented
+        """)
+    finds = registry_drift.METRIC_RULE.check(ctx)
+    msgs = "\n".join(f.message for f in finds)
+    assert "'mystery/thing' matches no namespace" in msgs
+    assert "train/loss" not in msgs
+    assert "'dead/metric' has no trace" in msgs
+
+
+def test_metric_drift_fstring_prefix_checks_namespace(tmp_path):
+    ctx = _drift_repo(tmp_path, """\
+        def f(writer, k, v):
+            writer.scalar(f"train/{k}", v)     # prefix under train/*
+            writer.scalar(f"rogue/{k}", v)     # prefix matches nothing
+        """)
+    finds = registry_drift.METRIC_RULE.check(ctx)
+    msgs = "\n".join(f.message for f in finds)
+    assert "'rogue/'" in msgs and "'train/'" not in msgs
+
+
+def test_live_doc_tables_parse():
+    text = (REPO_ROOT / "docs/OBSERVABILITY.md").read_text()
+    events = registry_drift._doc_names(
+        text, registry_drift.EVENT_TABLE_HEADER)
+    metrics = registry_drift._doc_names(
+        text, registry_drift.METRIC_TABLE_HEADER)
+    assert {"checkpoint_commit", "snapshot_fork", "peer_restore",
+            "save_stall", "snapshot_drop"} <= set(events)
+    assert "fleet/straggler_ratio" in metrics
+
+
+# -- bench-stages -------------------------------------------------------------
+
+_BENCH = """\
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--serve", action="store_true")
+    p.add_argument("--input", action="store_true")
+    p.add_argument("--steps", type=int, default=10)
+    """
+
+
+def _bench_repo(tmp_path, measure: str, retry: str) -> Context:
+    return repo_of(tmp_path, {
+        "bench.py": _BENCH,
+        "scripts/measure_all.sh": measure,
+        "scripts/retry_missed_stages.sh": retry,
+    })
+
+
+def test_bench_stage_missing_from_one_script_is_flagged(tmp_path):
+    ctx = _bench_repo(
+        tmp_path,
+        "python bench.py --serve\npython bench.py --input\n",
+        "python bench.py --serve\n")  # retry forgot --input
+    finds = bench_stages.RULE.check(ctx)
+    assert len(finds) == 1
+    assert "--input" in finds[0].message
+    assert "retry_missed_stages.sh" in finds[0].message
+
+
+def test_bench_reverse_catches_undefined_flag(tmp_path):
+    ctx = _bench_repo(
+        tmp_path,
+        "python bench.py --serve --typo-stage\npython bench.py --input\n",
+        "python bench.py --serve\npython bench.py --input\n")
+    finds = bench_stages.RULE.check(ctx)
+    assert any("--typo-stage" in f.message and "no such flag" in f.message
+               for f in finds)
+
+
+def test_bench_clean_when_both_scripts_cover_all_modes(tmp_path):
+    ctx = _bench_repo(
+        tmp_path,
+        "python bench.py --serve\npython bench.py --input --steps 5\n",
+        "python bench.py --serve\npython bench.py --input\n")
+    assert bench_stages.RULE.check(ctx) == []
+
+
+# -- suppressions -------------------------------------------------------------
+
+def test_suppression_own_line_line_above_and_multi_rule(tmp_path):
+    sf = sf_of(tmp_path, """\
+        def step(x, arr):
+            a = float(x)  # lint: ok[host-sync] fixture same-line
+            # lint: ok[host-sync] fixture marker-above
+            b = jax.device_get(x)
+            # lint: ok[host-sync, spmd-divergence] fixture multi-rule
+            c = arr.item()
+            return a, b, c
+        """)
+    assert sf.is_suppressed("host-sync", 2)
+    assert sf.is_suppressed("host-sync", 4)
+    assert sf.is_suppressed("host-sync", 6)
+    assert sf.is_suppressed("spmd-divergence", 6)
+    assert not sf.is_suppressed("host-sync", 7)
+
+
+def test_legacy_host_sync_marker_still_honored(tmp_path):
+    sf = sf_of(tmp_path, """\
+        def step(x):
+            return float(x)  # host-sync-ok: legacy form
+        """)
+    assert sf.is_suppressed("host-sync", 2)
+    assert sf.suppressions[0].legacy
+
+
+def test_reasonless_suppression_is_itself_a_finding(tmp_path):
+    ctx = repo_of(tmp_path, {
+        "dist_mnist_tpu/mod.py": """\
+            def step(x):
+                a = float(x)  # lint: ok[host-sync]
+                return a
+            """,
+    })
+    ctx.source("dist_mnist_tpu/mod.py")  # pull into the parse cache
+    result = run(ctx, [])
+    assert [f.rule for f in result["findings"]] == ["suppression-hygiene"]
+
+
+def test_engine_applies_suppressions_to_rule_findings(tmp_path):
+    class Fires:
+        rule_id = "host-sync"
+        doc = ""
+
+        def check(self, ctx):
+            sf = ctx.source("dist_mnist_tpu/mod.py")
+            return [Finding("host-sync", sf.rel, 2, "fixture finding")]
+
+    ctx = repo_of(tmp_path, {
+        "dist_mnist_tpu/mod.py": """\
+            def step(x):
+                return float(x)  # lint: ok[host-sync] fixture reason
+            """,
+    })
+    result = run(ctx, [Fires()])
+    assert result["findings"] == [] and result["suppressed"] == 1
+
+
+# -- baseline -----------------------------------------------------------------
+
+def test_baseline_round_trip_partition_and_stale(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"entries": [
+        {"rule": "r", "path": "a.py", "match": "known debt",
+         "reason": "fixture"},
+        {"rule": "r", "path": "gone.py", "match": "paid off",
+         "reason": "fixture"},
+    ]}))
+    bl = baseline_mod.Baseline.load(path)
+    new, old = bl.partition([
+        Finding("r", "a.py", 3, "this is known debt, grandfathered"),
+        Finding("r", "a.py", 9, "a fresh regression"),
+    ])
+    assert [f.line for f in old] == [3]
+    assert [f.line for f in new] == [9]
+    assert [e["match"] for e in bl.stale_entries()] == ["paid off"]
+
+
+def test_baseline_rejects_empty_reason_and_missing_keys():
+    with pytest.raises(baseline_mod.BaselineError, match="empty reason"):
+        baseline_mod.Baseline([{"rule": "r", "path": "p", "match": "m",
+                                "reason": "   "}])
+    with pytest.raises(baseline_mod.BaselineError, match="missing"):
+        baseline_mod.Baseline([{"rule": "r", "path": "p"}])
+
+
+def test_live_baseline_entries_all_carry_reasons():
+    bl = baseline_mod.Baseline.load(
+        REPO_ROOT / baseline_mod.DEFAULT_NAME)  # raises on empty reasons
+    for e in bl.entries:
+        assert e["reason"].strip()
+
+
+# -- the meta-test: this tree is clean ----------------------------------------
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "dist_mnist_tpu.analysis", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+
+
+def test_live_tree_is_clean_and_json_schema_is_stable():
+    proc = _run_cli("--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["version"] == 1
+    assert set(data) == {"version", "rules", "findings", "baselined",
+                         "suppressed", "stale_baseline"}
+    assert data["findings"] == []
+    assert len(data["rules"]) >= 6
+    assert data["suppressed"] > 0      # the ported hot-path annotations
+    assert data["stale_baseline"] == []  # no paid-off debt left behind
+
+
+def test_cli_rule_selection_and_unknown_rule_exit_codes():
+    assert _run_cli("--rules", "bench-stages").returncode == 0
+    proc = _run_cli("--rules", "no-such-rule")
+    assert proc.returncode == 2
+    assert "no-such-rule" in proc.stderr
+
+
+def test_cli_reports_violations_with_exit_1(tmp_path):
+    # a copy of the minimal drift repo, driven through the real CLI
+    for rel, text in {
+        "docs/OBSERVABILITY.md": _DOC,
+        "dist_mnist_tpu/__init__.py": "",
+        "dist_mnist_tpu/mod.py": (
+            "def f(events):\n"
+            "    events.emit('rogue_event')\n"),
+        "scripts/measure_all.sh": "",
+    }.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    proc = _run_cli("--repo-root", str(tmp_path), "--rules",
+                    "journal-drift")
+    assert proc.returncode == 1
+    assert "dist_mnist_tpu/mod.py:2: journal-drift" in proc.stdout
