@@ -43,6 +43,8 @@ HOT_PATH_TARGETS = (
     "dist_mnist_tpu/ops/quant.py",
     "dist_mnist_tpu/serve/engine.py",
     "dist_mnist_tpu/serve/loader.py",
+    "dist_mnist_tpu/serve/decode.py",
+    "dist_mnist_tpu/models/causal_lm.py",
 )
 
 

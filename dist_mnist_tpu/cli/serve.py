@@ -4,10 +4,14 @@ with the deterministic load generator, print a latency/batching summary.
 `python -m dist_mnist_tpu.cli.serve --config=mlp_mnist \
     --checkpoint_dir=/tmp/ckpt --platform=cpu --host_device_count=8`
 
-Two modes:
+Three modes:
 
 - default: drive the server with the deterministic load generator and
   exit — the transport-free latency/batching harness.
+- ``--decode``: autoregressive decode serving (serve/decode.py) — a
+  registry causal LM behind the prefill/decode split, continuous
+  batching over the sharded KV cache, driven by the seeded decode
+  loadgen; prints the TTFT/per-token-throughput summary.
 - ``--serve_forever``: run as one FLEET REPLICA until SIGTERM/SIGINT.
   The metrics exporter doubles as the data plane (obs/exporter.py
   do_POST): POST /predict executes one inference, POST /swap quiesces
@@ -87,6 +91,22 @@ flags.DEFINE_string("compile_cache_dir", None,
                     "compiled (<dir>/exe) instead of recompiling, and JAX's "
                     "persistent compilation cache runs under <dir>/xla; "
                     "None = cold start")
+# -- autoregressive decode serving (serve/decode.py) -------------------------
+flags.DEFINE_boolean("decode", False,
+                     "autoregressive decode mode: serve a registry causal "
+                     "LM through the prefill/decode split with continuous "
+                     "batching over a sharded KV cache, drive it with the "
+                     "seeded decode loadgen, print the TTFT/throughput "
+                     "summary (docs/SERVING.md). --config is ignored; "
+                     "--mesh/--platform/--metrics_port/--journal apply")
+flags.DEFINE_string("decode_mode", "continuous",
+                    'decode scheduling: "continuous" (admit between steps) '
+                    'or "static" (the drain-the-whole-batch baseline)')
+flags.DEFINE_integer("max_slots", 8,
+                     "in-flight sequence capacity in --decode mode")
+flags.DEFINE_string("decode_model", "causal_tiny",
+                    "models/registry.py name of the causal LM to serve in "
+                    "--decode mode")
 # -- load generation ---------------------------------------------------------
 flags.DEFINE_integer("requests", 512, "loadgen request count")
 flags.DEFINE_integer("concurrency", 64, "loadgen in-flight window")
@@ -173,6 +193,43 @@ def _serve_forever(server, exporter, cfg, mesh) -> dict:
     return summary
 
 
+def _run_decode(mesh, registry) -> dict:
+    """Decode mode: build the LM engine + continuous-batching scheduler,
+    prewarm the full prefill/decode grid, drive it with the seeded decode
+    loadgen, and return the TTFT/throughput summary."""
+    from dist_mnist_tpu.obs.writers import make_default_writer
+    from dist_mnist_tpu.serve import (
+        DecodeScheduler,
+        build_decode_engine,
+        run_decode_loadgen,
+    )
+
+    engine = build_decode_engine(
+        mesh, model_name=FLAGS.decode_model, seed=FLAGS.seed,
+        max_slots=FLAGS.max_slots)
+    if FLAGS.prewarm:
+        engine.prewarm()
+    writer = make_default_writer(FLAGS.logdir, registry=registry)
+    scheduler = DecodeScheduler(engine, mode=FLAGS.decode_mode,
+                                max_queue=FLAGS.queue_depth, writer=writer)
+    # live TTFT/throughput/occupancy ladders on /metrics
+    scheduler.metrics.attach_to(registry)
+    try:
+        summary = run_decode_loadgen(
+            scheduler,
+            n_requests=FLAGS.requests,
+            concurrency=FLAGS.concurrency,
+            seed=FLAGS.seed,
+        )
+    finally:
+        scheduler.close()
+    summary.pop("token_times", None)
+    summary["mode"] = FLAGS.decode_mode
+    summary["max_slots"] = FLAGS.max_slots
+    summary["model"] = FLAGS.decode_model
+    return summary
+
+
 def main(argv):
     del argv
     logging.basicConfig(
@@ -240,7 +297,23 @@ def main(argv):
     if FLAGS.mesh:
         kv = dict(part.split("=") for part in FLAGS.mesh.split(","))
         spec = MeshSpec(**{k: int(v) for k, v in kv.items()})
+    if FLAGS.decode and not FLAGS.mesh:
+        # decode serves a registry LM, not the config's classifier: the
+        # config mesh is irrelevant, default to all devices on data
+        spec = MeshSpec(data=-1)
     mesh = make_mesh(spec)
+
+    if FLAGS.decode:
+        try:
+            summary = _run_decode(mesh, registry)
+        finally:
+            if exporter is not None:
+                exporter.close()
+            if journal is not None:
+                events_mod.set_journal(None)
+                journal.close()
+        print(json.dumps(summary, indent=2, sort_keys=True))
+        return
 
     bundle = load_for_serving(
         cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step,

@@ -1,0 +1,317 @@
+"""Tiny causal autoregressive transformer — the decode-serving workload.
+
+Everything else in `models/` classifies a whole input in one forward;
+this model emits one token at a time, which is what the decode serving
+subsystem (serve/decode.py) exists to schedule. Geometry mirrors
+`models/vit.py` (pre-LN blocks, learned positions, `ops/nn` attention
+params) with two differences forced by autoregression:
+
+- **Causal attention is implemented here**, not via
+  `nn.dot_product_attention`: that kernel's mask is key-only ``[B, S_k]``
+  (variable-length serving) and cannot express a per-query causal
+  frontier. The math keeps the same accumulation contract (f32 scores
+  and softmax, -1e30 masking) so numerics match the rest of the repo.
+- **Two forward surfaces over one set of weights**: `apply`/`prefill`
+  run the whole sequence with a triangular mask (and prefill writes
+  every position's K/V into a cache), while `decode_step` runs ONE new
+  token per slot against the cache, updating it in place with
+  `lax.dynamic_update_slice`. Both routes share `_attend`, so an
+  incremental decode reproduces the full-sequence forward bit-for-bit
+  at every position (tests/test_serve_decode.py holds this).
+
+Tensor parallelism follows `parallel/flash.py`: when the ambient mesh
+has a model axis >1 and it divides `heads`, the attention kernel — cache
+write included — runs under `compat_shard_map` with heads sharded, so
+each device owns its head slice of the KV cache and updates it locally
+(no collectives: attention is head-parallel, the out-projection happens
+on the gathered activations outside the shard_map).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import (
+    MODEL_AXIS,
+    ambient_mesh,
+    compat_shard_map,
+)
+from dist_mnist_tpu.ops import nn
+
+
+def _attend(q, k, v, mask):
+    """Masked multi-head attention: q ``[B,Sq,H,D]`` against k/v
+    ``[B,Sk,H,D]`` with a boolean mask ``[B,Sq,Sk]`` (True = attend).
+    f32 scores and softmax regardless of the activation dtype — the same
+    accumulation contract as `nn.dot_product_attention`.
+
+    Both contractions are broadcast-multiply + ``jnp.sum`` rather than
+    einsums ON PURPOSE: XLA lowers a dot_general's accumulation order
+    per gemm tiling, which varies with the query-length (M) dimension —
+    measured on CPU, ``weights @ v`` at Sq=1 rounds differently from
+    Sq=S by ~1 ulp. A single-axis reduce is per-output-element and
+    independent of the other dims, which is what lets an incremental
+    decode (Sq=1) bit-match the full-sequence forward at every position
+    — the correctness contract tests/test_serve_decode.py pins. The
+    O(Sq*Sk*H*D) broadcast is fine at this model's serving scale."""
+    dh = q.shape[-1]
+    # [B,Sq,Sk,H] <- sum_d q[B,Sq,1,H,D] * k[B,1,Sk,H,D]
+    scores = jnp.sum(
+        q.astype(jnp.float32)[:, :, None] * k.astype(jnp.float32)[:, None],
+        axis=-1)
+    scores = scores.transpose(0, 3, 1, 2)  # [B,H,Sq,Sk]
+    scores = scores * (1.0 / jnp.sqrt(jnp.float32(dh)))
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    # [B,H,Sq,D] <- sum_k w[B,H,Sq,Sk,1] * v[B,H,1,Sk,D]
+    out = jnp.sum(
+        weights[..., None] * jnp.moveaxis(v, 1, 2)[:, :, None], axis=3)
+    return out.transpose(0, 2, 1, 3)  # [B,Sq,H,D]
+
+
+def _write_step(cache, new, pos):
+    """Write one token's K (or V) per slot: ``cache`` [R,S,H,D], ``new``
+    [R,1,H,D], ``pos`` [R] — row r gets its token at ``pos[r]``."""
+    return jax.vmap(
+        lambda c, n, p: lax.dynamic_update_slice(c, n, (p, 0, 0))
+    )(cache, new, pos)
+
+
+def _decode_attn_update(q, k_new, v_new, k_cache, v_cache, pos):
+    """One cached-attention step (runs per head-shard under shard_map):
+    write the new K/V at each slot's position, then attend the single
+    query against keys ``<= pos`` — write-before-attend is what lets a
+    freshly admitted slot overwrite stale prefill padding before any
+    mask ever admits it."""
+    k_cache = _write_step(k_cache, k_new, pos)
+    v_cache = _write_step(v_cache, v_new, pos)
+    max_seq = k_cache.shape[1]
+    mask = jnp.arange(max_seq)[None, None, :] <= pos[:, None, None]
+    return _attend(q, k_cache, v_cache, mask), k_cache, v_cache
+
+
+def _attend_gather(q, k, v, mask):
+    """Shard-mapped body for the full-sequence forward: per-device local
+    heads, then a tiled all_gather back to the full head axis so the
+    OUTPUT leaves the shard_map replicated. Gathering here (instead of
+    letting GSPMD psum a heads-sharded out-projection) trades one small
+    activation gather for bitwise parity with the unsharded path — the
+    partial-sum reduction order of a sharded contraction is not the
+    unsharded order, and this model's contract is bit-stable logits."""
+    o = _attend(q, k, v, mask)
+    return lax.all_gather(o, MODEL_AXIS, axis=2, tiled=True)
+
+
+def _decode_attn_update_gather(q, k_new, v_new, k_cache, v_cache, pos):
+    """Shard-mapped decode body: caches stay head-sharded (device-local
+    in-place update), the attention output gathers (see above)."""
+    o, ck, cv = _decode_attn_update(q, k_new, v_new, k_cache, v_cache, pos)
+    return lax.all_gather(o, MODEL_AXIS, axis=2, tiled=True), ck, cv
+
+
+def _heads_spec(mesh, heads):
+    """PartitionSpec sharding the heads axis of [B,S,H,D] over the model
+    axis, or None when the mesh can't (absent/singleton axis). Raising on
+    an indivisible head count mirrors parallel/flash.py: silently
+    replicating a "TP" cache would defeat the memory story."""
+    shape = getattr(mesh, "shape", {}) if mesh is not None else {}
+    m = shape.get(MODEL_AXIS, 1)
+    if m <= 1:
+        return None
+    if heads % m:
+        raise ValueError(
+            f"heads={heads} not divisible by model axis {m}; "
+            "the TP-sharded KV cache needs heads % model == 0"
+        )
+    return P(None, None, MODEL_AXIS, None)
+
+
+@dataclasses.dataclass(frozen=True)
+class CausalLMTiny:
+    """Small decoder-only LM over a synthetic token alphabet.
+
+    `init`/`apply` satisfy the `models/base.py` Model protocol
+    (sample_input is a ``[B, S]`` int token batch or None — only the
+    vocab/geometry fields size the params). `prefill`/`decode_step`/
+    `init_cache` are the serving surface consumed by serve/decode.py.
+    """
+
+    vocab_size: int = 256
+    dim: int = 64
+    depth: int = 2
+    heads: int = 4
+    mlp_ratio: int = 4
+    max_seq: int = 64
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+    def init(self, rng, sample_input=None):
+        if self.dim % self.heads:
+            raise ValueError(f"dim {self.dim} % heads {self.heads} != 0")
+        keys = jax.random.split(rng, 3 + self.depth)
+        d = self.dim
+        params: dict = {
+            "tok_emb": 0.02 * jax.random.normal(keys[0],
+                                                (self.vocab_size, d)),
+            "pos": 0.02 * jax.random.normal(keys[1], (1, self.max_seq, d)),
+            "final_ln": nn.init_layer_norm(d),
+            "lm_head": nn.init_dense(keys[2], d, self.vocab_size,
+                                     init=nn.xavier_uniform),
+        }
+        for i in range(self.depth):
+            k1, k2, k3 = jax.random.split(keys[3 + i], 3)
+            params[f"block{i}"] = {
+                "ln1": nn.init_layer_norm(d),
+                "attn": nn.init_attention(k1, d, self.heads),
+                "ln2": nn.init_layer_norm(d),
+                "mlp_in": nn.init_dense(k2, d, d * self.mlp_ratio,
+                                        init=nn.xavier_uniform),
+                "mlp_out": nn.init_dense(k3, d * self.mlp_ratio, d,
+                                         init=nn.xavier_uniform),
+            }
+        return params, {}
+
+    def _qkv(self, p, x):
+        b, s, d = x.shape
+        qkv = nn.dense(p["qkv"], x).reshape(b, s, 3, self.heads,
+                                            self.head_dim)
+        return jnp.moveaxis(qkv, 2, 0)
+
+    def _mlp(self, p, x):
+        y = nn.layer_norm(p["ln2"], x)
+        return x + nn.dense(p["mlp_out"], nn.gelu(nn.dense(p["mlp_in"], y)))
+
+    def _forward(self, params, tokens):
+        """Full-sequence causal forward: tokens ``[B,S]`` ->
+        (logits ``[B,S,V]`` f32, per-layer (k, v) list). Positions past a
+        prompt's real length produce garbage logits but — causality —
+        never influence earlier positions, so callers simply index the
+        rows they care about."""
+        b, s = tokens.shape
+        if s > self.max_seq:
+            raise ValueError(f"sequence {s} > max_seq {self.max_seq}")
+        x = params["tok_emb"][tokens].astype(self.compute_dtype)
+        x = x + params["pos"][:, :s].astype(x.dtype)
+        causal = jnp.broadcast_to(
+            jnp.tril(jnp.ones((s, s), bool))[None], (b, s, s))
+        mesh = ambient_mesh()
+        spec = _heads_spec(mesh, self.heads)
+        if spec is None:
+            attend = _attend
+        else:
+            attend = compat_shard_map(
+                _attend_gather, mesh=mesh,
+                in_specs=(spec, spec, spec, P(None, None, None)),
+                out_specs=P(None, None, None, None))
+        kv = []
+        for i in range(self.depth):
+            p = params[f"block{i}"]
+            y = nn.layer_norm(p["ln1"], x)
+            q, k, v = self._qkv(p["attn"], y)
+            o = attend(q, k, v, causal)
+            x = x + nn.dense(p["attn"]["out"], o.reshape(b, s, self.dim))
+            x = self._mlp(p, x)
+            kv.append((k, v))
+        x = nn.layer_norm(params["final_ln"], x)
+        logits = nn.dense(params["lm_head"], x)
+        return logits.astype(jnp.float32), kv
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        """Model-protocol forward: next-token logits at every position."""
+        del train, rng
+        logits, _ = self._forward(params, x)
+        return logits, state
+
+    def flops_per_example(self, sample_shape) -> float:
+        """Analytic forward FLOPs (matmul MACs x2), mirroring vit.py."""
+        s = int(sample_shape[1])
+        d = self.dim
+        per_block = (
+            s * 3 * d * d * 2
+            + 2 * s * s * d * 2
+            + s * d * d * 2
+            + 2 * s * d * (d * self.mlp_ratio) * 2
+        )
+        head = s * d * self.vocab_size * 2
+        # lint: ok[host-sync] pure python-int arithmetic, no device values
+        return float(self.depth * per_block + head)
+
+    # ---- serving surface (serve/decode.py) ----------------------------
+
+    def init_cache(self, slots: int) -> dict:
+        """Preallocated KV cache: ``[depth, slot, max_seq, heads,
+        head_dim]`` per tensor, zero-filled. The serve engine device_puts
+        this with the heads axis sharded over the model mesh axis."""
+        shape = (self.depth, slots, self.max_seq, self.heads, self.head_dim)
+        return {"k": jnp.zeros(shape, self.compute_dtype),
+                "v": jnp.zeros(shape, self.compute_dtype)}
+
+    def prefill(self, params, cache, tokens, slot_ids, lengths):
+        """Run whole prompts and land their K/V in the cache.
+
+        tokens ``[n, S_b]`` (right-padded to the prompt bucket), slot_ids
+        ``[n]`` (cache rows; padding rows point at the engine's scratch
+        slot), lengths ``[n]``. Returns (logits-at-last-real-position
+        ``[n, V]``, updated cache). Padding positions >= length DO write
+        garbage K/V — harmless, because decode's write-before-attend
+        masking overwrites position p before any query can see it."""
+        logits, kv = self._forward(params, tokens)
+        n = tokens.shape[0]
+        new_k, new_v = [], []
+        for i, (k, v) in enumerate(kv):
+            ck, cv = cache["k"][i], cache["v"][i]
+            # sequential per-row writes (n is a static bucket size):
+            # last-write-wins keeps duplicate scratch-slot rows harmless
+            for j in range(n):
+                at = (slot_ids[j], jnp.int32(0), jnp.int32(0), jnp.int32(0))
+                ck = lax.dynamic_update_slice(ck, k[j][None], at)
+                cv = lax.dynamic_update_slice(cv, v[j][None], at)
+            new_k.append(ck)
+            new_v.append(cv)
+        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        return last, cache
+
+    def decode_step(self, params, cache, tokens, positions):
+        """One token per slot: tokens ``[R]`` are each slot's most recent
+        token, positions ``[R]`` where it goes in that slot's sequence.
+        Returns (next-token logits ``[R, V]`` f32, updated cache). Each
+        slot row only ever reads its own cache rows, so per-request
+        streams are independent of batch composition — the invariant that
+        makes continuous and static scheduling bit-identical."""
+        r = tokens.shape[0]
+        x = params["tok_emb"][tokens].astype(self.compute_dtype)
+        x = (x + params["pos"][0][positions].astype(x.dtype))[:, None, :]
+        mesh = ambient_mesh()
+        spec = _heads_spec(mesh, self.heads)
+        if spec is None:
+            step = _decode_attn_update
+        else:
+            step = compat_shard_map(
+                _decode_attn_update_gather, mesh=mesh,
+                in_specs=(spec,) * 5 + (P(None),),
+                out_specs=(P(None, None, None, None), spec, spec))
+        new_k, new_v = [], []
+        for i in range(self.depth):
+            p = params[f"block{i}"]
+            y = nn.layer_norm(p["ln1"], x)
+            q, k, v = self._qkv(p["attn"], y)
+            o, ck, cv = step(q, k, v, cache["k"][i], cache["v"][i],
+                             positions)
+            new_k.append(ck)
+            new_v.append(cv)
+            x = x + nn.dense(p["attn"]["out"], o.reshape(r, 1, self.dim))
+            x = self._mlp(p, x)
+        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        x = nn.layer_norm(params["final_ln"], x)
+        logits = nn.dense(params["lm_head"], x[:, 0])
+        return logits.astype(jnp.float32), cache

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from dist_mnist_tpu.models.causal_lm import CausalLMTiny
 from dist_mnist_tpu.models.lenet import LeNet5
 from dist_mnist_tpu.models.mlp import MLP
 from dist_mnist_tpu.models.resnet import ResNet20
@@ -12,6 +13,7 @@ MODELS = {
     "lenet5": LeNet5,
     "resnet20": ResNet20,
     "vit_tiny": ViTTiny,
+    "causal_tiny": CausalLMTiny,
 }
 
 

@@ -21,6 +21,8 @@ Layering (each module depends only on those above it):
     router.py     fleet facade: N replicas, tiered shedding, failover,
                   hedging, zero-downtime weight hot-swap
     loadgen.py    deterministic closed-loop load generators (bench + tests)
+    decode.py     autoregressive decode serving: prefill/decode split,
+                  sharded KV cache, continuous batching
 """
 
 from dist_mnist_tpu.serve.admission import (
@@ -28,6 +30,11 @@ from dist_mnist_tpu.serve.admission import (
     DeadlineExceededError,
     QueueFullError,
     ShuttingDownError,
+)
+from dist_mnist_tpu.serve.decode import (
+    DecodeEngine,
+    DecodeResult,
+    DecodeScheduler,
 )
 from dist_mnist_tpu.serve.engine import (
     CompiledModelCache,
@@ -40,15 +47,22 @@ from dist_mnist_tpu.serve.errors import (
     ShedError,
     classify_failure,
 )
-from dist_mnist_tpu.serve.loader import load_for_serving, quantize_for_serving
+from dist_mnist_tpu.serve.loader import (
+    init_lm_for_serving,
+    load_for_serving,
+    quantize_for_serving,
+)
 from dist_mnist_tpu.serve.loadgen import (
+    make_prompts,
+    run_decode_loadgen,
     run_fleet_loadgen,
     run_loadgen,
     run_longctx_loadgen,
 )
-from dist_mnist_tpu.serve.metrics import ServeMetrics
+from dist_mnist_tpu.serve.metrics import DecodeMetrics, ServeMetrics
 from dist_mnist_tpu.serve.router import (
     BEST_EFFORT,
+    DECODE_SLO_TARGETS,
     LATENCY_SENSITIVE,
     CheckpointWatcher,
     HttpReplica,
@@ -58,8 +72,11 @@ from dist_mnist_tpu.serve.router import (
 )
 from dist_mnist_tpu.serve.server import InferenceServer, ServeConfig
 from dist_mnist_tpu.serve.zoo import (
+    DecodeGrid,
     SeqGrid,
+    build_decode_engine,
     build_zoo_engine,
+    default_decode_grid,
     default_seq_grid,
     parse_seq_buckets,
     supports_mask,
@@ -71,7 +88,13 @@ __all__ = [
     "BEST_EFFORT",
     "CheckpointWatcher",
     "CompiledModelCache",
+    "DECODE_SLO_TARGETS",
     "DeadlineExceededError",
+    "DecodeEngine",
+    "DecodeGrid",
+    "DecodeMetrics",
+    "DecodeResult",
+    "DecodeScheduler",
     "HttpReplica",
     "InProcessReplica",
     "InferenceEngine",
@@ -87,12 +110,17 @@ __all__ = [
     "ServeMetrics",
     "ShedError",
     "ShuttingDownError",
+    "build_decode_engine",
     "build_zoo_engine",
     "classify_failure",
+    "default_decode_grid",
     "default_seq_grid",
+    "init_lm_for_serving",
     "load_for_serving",
+    "make_prompts",
     "parse_seq_buckets",
     "quantize_for_serving",
+    "run_decode_loadgen",
     "run_fleet_loadgen",
     "run_loadgen",
     "run_longctx_loadgen",

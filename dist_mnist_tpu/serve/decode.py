@@ -1,0 +1,569 @@
+"""Autoregressive decode serving: prefill/decode split, sharded KV cache,
+continuous batching.
+
+The classifier serve stack (engine/batcher) answers one request with one
+forward; decode serving answers one request with a prefill plus N
+single-token steps whose state — the KV cache — lives on device between
+steps. That forces a different execution shape, built here in two
+layers (docs/SERVING.md "Autoregressive decode"):
+
+`DecodeEngine` — owns the device state and the compiled programs:
+
+- The KV cache is **engine-owned sharded device state**: per-model-layer
+  ``[slot, max_seq, heads, head_dim]`` buffers (models/causal_lm.py
+  `init_cache`), device_put with the heads axis sharded over the mesh's
+  `model` axis (the parallel/flash.py TP placement) and updated IN PLACE
+  by `lax.dynamic_update_slice` inside the jitted step — the cache
+  argument is donated, so steps never copy it.
+- **Prefill and decode are separate executables** on the
+  `serve/zoo.DecodeGrid`: prefill cells bucket (admit batch, prompt
+  length) exactly like the classifier's (batch, seq) grid; decode is one
+  program at full slot capacity. `prewarm()` compiles every cell through
+  the shared `CompiledModelCache`, so mixed traffic never recompiles —
+  `cache.stats()["misses"]` deltas are the proof the bench asserts on.
+- A request's prompt bucket depends on ITS OWN length only, never on
+  the admission batch — the property that keeps token streams
+  bit-identical between scheduling modes.
+
+`DecodeScheduler` — **continuous batching** over the engine's slots (one
+daemon thread, name prefix ``DecodeScheduler`` in the conftest leak
+registry): between any two decode steps it admits queued requests into
+free slots (prefill), evicts finished sequences, and NEVER drains the
+in-flight batch to make room — a fresh request rides along with
+sequences mid-generation. Router SLO classes map onto decode SLOs
+(serve/router.DECODE_SLO_TARGETS): `latency_sensitive` requests jump the
+admission queue (time-to-first-token), `best_effort` fills remaining
+slots (per-token throughput). ``mode="static"`` is the measured
+baseline: admit a batch, decode until EVERY member finishes, only then
+admit again — same executables, same per-request streams, strictly worse
+tail TTFT (bench.py --serve --decode shows the gap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from dist_mnist_tpu.cluster.mesh import MODEL_AXIS, activate
+from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.serve.admission import QueueFullError, ShuttingDownError
+from dist_mnist_tpu.serve.engine import CompiledModelCache
+from dist_mnist_tpu.serve.metrics import DecodeMetrics
+from dist_mnist_tpu.serve.router import (
+    BEST_EFFORT,
+    DECODE_SLO_TARGETS,
+    LATENCY_SENSITIVE,
+    REQUEST_CLASSES,
+)
+
+log = logging.getLogger(__name__)
+
+#: scheduler idle poll (waiting for the first/next request), mirroring
+#: serve/batcher.py
+_IDLE_POLL_SECS = 0.05
+
+_SCHED_IDS = itertools.count()
+
+
+class DecodeEngine:
+    """Compiled prefill/decode programs + the sharded KV cache they share.
+
+    Single-owner by design: the KV cache and the per-call donation of it
+    make concurrent callers nonsensical — the scheduler thread is the one
+    driver. Engines on the same mesh CAN share a `CompiledModelCache`
+    (executables close over no weights), which is how the bench runs
+    continuous and static modes on one compiled set.
+    """
+
+    def __init__(self, model, params, mesh: Mesh, *,
+                 model_name: str = "causal_lm", grid=None,
+                 max_slots: int = 8, store=None,
+                 cache: CompiledModelCache | None = None):
+        from dist_mnist_tpu.serve.zoo import default_decode_grid
+
+        self.model = model
+        self.mesh = mesh
+        self.model_name = model_name
+        self.grid = grid if grid is not None else default_decode_grid(
+            model, max_slots=max_slots)
+        self.max_slots = self.grid.max_slots
+        self.max_seq = int(model.max_seq)
+        if self.grid.max_seq != self.max_seq:
+            raise ValueError(
+                f"grid max_seq {self.grid.max_seq} != model max_seq "
+                f"{self.max_seq}")
+        self.cache = cache if cache is not None else CompiledModelCache(
+            store=store)
+        self._rep = NamedSharding(mesh, P())
+        # the TP placement: heads axis of [layer, slot, seq, head, dim]
+        # rides the model axis (parallel/flash.py's spec, one rank up for
+        # the layer stack). Indivisible head counts fail HERE, not deep
+        # inside XLA partitioning (models/causal_lm._heads_spec raises at
+        # trace time with the same contract).
+        m = dict(mesh.shape).get(MODEL_AXIS, 1)
+        heads = int(model.heads)
+        if m > 1 and heads % m:
+            raise ValueError(
+                f"heads={heads} not divisible by model axis {m}; "
+                "the TP-sharded KV cache needs heads % model == 0")
+        self._kv_shd = (NamedSharding(
+            mesh, P(None, None, None, MODEL_AXIS, None))
+            if m > 1 else self._rep)
+        self.params = jax.device_put(params, self._rep)
+        #: the live cache state: slots + 1 rows (scratch row absorbs
+        #: prefill-padding writes), donated to and rebound from every step
+        self.kv = jax.device_put(model.init_cache(self.grid.rows),
+                                 self._kv_shd)
+        base = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in jax.tree.leaves(self.params)) \
+            + sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                  for a in jax.tree.leaves(self.kv))
+        self.cache.set_base_bytes(base // max(1, mesh.size))
+
+    # -- compilation --------------------------------------------------------
+
+    def _mesh_key(self):
+        return tuple(sorted(dict(self.mesh.shape).items()))
+
+    def _key(self, cell: tuple):
+        dt = str(jnp.dtype(self.model.compute_dtype))
+        return (self.model_name, "decode_grid", cell, self.grid.rows,
+                self.max_seq, self._mesh_key(), dt)
+
+    def _store_key(self, cell: tuple) -> str | None:
+        if self.cache._store is None:
+            return None
+        from dist_mnist_tpu.compilecache import cache_key
+
+        return cache_key({
+            "kind": "serve_decode",
+            "model": self.model_name,
+            "cell": cell,
+            "rows": self.grid.rows,
+            "max_seq": self.max_seq,
+            "mesh": self._mesh_key(),
+            "dtype": str(jnp.dtype(self.model.compute_dtype)),
+        })
+
+    def _abstract_kv(self):
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=self._kv_shd), self.kv)
+
+    def _compile_decode(self):
+        rows = self.grid.rows
+
+        def step(params, kv, tokens, positions):
+            logits, kv = self.model.decode_step(params, kv, tokens,
+                                                positions)
+            # greedy argmax in-graph: the host reads token ids, never the
+            # [rows, vocab] logits
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(self._rep, self._kv_shd, self._rep, self._rep),
+            out_shardings=(self._rep, self._kv_shd),
+            donate_argnums=(1,))
+        ivec = jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=self._rep)
+        with activate(self.mesh):
+            return jitted.lower(self.params, self._abstract_kv(),
+                                ivec, ivec).compile()
+
+    def _compile_prefill(self, n_bucket: int, s_bucket: int):
+        def fwd(params, kv, tokens, slot_ids, lengths):
+            logits, kv = self.model.prefill(params, kv, tokens, slot_ids,
+                                            lengths)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+        jitted = jax.jit(
+            fwd,
+            in_shardings=(self._rep, self._kv_shd, self._rep, self._rep,
+                          self._rep),
+            out_shardings=(self._rep, self._kv_shd),
+            donate_argnums=(1,))
+        toks = jax.ShapeDtypeStruct((n_bucket, s_bucket), jnp.int32,
+                                    sharding=self._rep)
+        ivec = jax.ShapeDtypeStruct((n_bucket,), jnp.int32,
+                                    sharding=self._rep)
+        with activate(self.mesh):
+            return jitted.lower(self.params, self._abstract_kv(),
+                                toks, ivec, ivec).compile()
+
+    def compiled_for(self, cell: tuple):
+        """The executable for a grid cell: ``("decode",)`` or
+        ``("prefill", n_bucket, s_bucket)``."""
+        if cell[0] == "decode":
+            build = self._compile_decode
+        else:
+            _, n_b, s_b = cell
+            build = lambda: self._compile_prefill(n_b, s_b)  # noqa: E731
+        return self.cache.get(self._key(cell), build,
+                              store_key=self._store_key(cell))
+
+    def prewarm(self) -> int:
+        """Compile the whole grid up front; returns programs compiled.
+        After this, live traffic hits the memory tier only — the
+        zero-recompile contract tests and the bench assert via
+        `cache.stats()["misses"]` deltas."""
+        n0 = self.cache.misses
+        for cell in self.grid.cells():
+            self.compiled_for(cell)
+        compiled = self.cache.misses - n0
+        events.emit("decode_prewarm", programs=len(self.grid.cells()),
+                    compiled=compiled)
+        return compiled
+
+    # -- execution ----------------------------------------------------------
+
+    def prefill(self, prompts: list, slot_ids: list) -> np.ndarray:
+        """Land `prompts[i]` (1-D int32 arrays) in cache slot
+        `slot_ids[i]` and return each prompt's FIRST generated token,
+        ``[len(prompts)]`` int32.
+
+        Grouping discipline: requests are grouped by their own prompt
+        bucket (stream determinism — see class docstring), each group
+        chunked to the admit-bucket grid; padding rows prefill a length-1
+        dummy into the scratch row."""
+        out = np.zeros(len(prompts), np.int32)
+        groups: dict = {}
+        for i, p in enumerate(prompts):
+            groups.setdefault(self.grid.prompt_bucket_for(len(p)),
+                              []).append(i)
+        max_admit = self.grid.admit_buckets[-1]
+        scratch = self.max_slots
+        for s_b, idxs in sorted(groups.items()):
+            for at in range(0, len(idxs), max_admit):
+                chunk = idxs[at:at + max_admit]
+                n_b = self.grid.admit_bucket_for(len(chunk))
+                tokens = np.zeros((n_b, s_b), np.int32)
+                slots = np.full((n_b,), scratch, np.int32)
+                lengths = np.ones((n_b,), np.int32)
+                for row, i in enumerate(chunk):
+                    tokens[row, :len(prompts[i])] = prompts[i]
+                    slots[row] = slot_ids[i]
+                    lengths[row] = len(prompts[i])
+                exe = self.compiled_for(("prefill", n_b, s_b))
+                first, self.kv = exe(self.params, self.kv, tokens, slots,
+                                     lengths)
+                # one intentional sync per admission: the scheduler needs
+                # the first token on host to stream it / update slot state
+                first = np.asarray(jax.device_get(first))  # lint: ok[host-sync] scheduler consumes token ids on host
+                for row, i in enumerate(chunk):
+                    out[i] = first[row]
+        return out
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray) -> np.ndarray:
+        """One step for every slot row: feed each slot's latest token at
+        its position, get back next-token ids ``[rows]`` int32. Inactive
+        rows compute garbage that their next prefill overwrites — the
+        batch shape never changes, which is why admission/eviction can
+        happen between any two steps without recompiling."""
+        exe = self.compiled_for(("decode",))
+        nxt, self.kv = exe(self.params, self.kv,
+                           np.asarray(tokens, np.int32),
+                           np.asarray(positions, np.int32))
+        # the one per-step sync decode serving cannot avoid: token ids
+        # drive host-side stop/admit decisions
+        return np.asarray(jax.device_get(nxt))  # lint: ok[host-sync] scheduler consumes token ids on host
+
+    def stats(self) -> dict:
+        return self.cache.stats()
+
+
+@dataclasses.dataclass
+class DecodeResult:
+    """One finished request: the greedy token stream plus its timeline.
+    `token_times` are monotonic stamps, one per token — `token_times[0] -
+    t_submit` is the TTFT the metrics aggregate."""
+
+    tokens: list
+    ttft_ms: float
+    latency_ms: float
+    token_times: list
+    request_class: str
+    prompt_len: int
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new_tokens", "request_class", "future",
+                 "t_submit", "tokens", "token_times", "slot")
+
+    def __init__(self, prompt, max_new_tokens, request_class):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.request_class = request_class
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+        self.tokens: list = []
+        self.token_times: list = []
+        self.slot: int | None = None
+
+
+class DecodeScheduler:
+    """Slot-allocating batcher over a `DecodeEngine` (one daemon thread).
+
+    ``mode="continuous"``: between steps, free slots are refilled from
+    the queue (latency_sensitive first) and finished sequences evicted —
+    the in-flight batch never drains. ``mode="static"``: admission only
+    when NO sequence is in flight (the whole batch finishes together),
+    the baseline continuous batching is measured against. Both modes run
+    the same executables in the same per-request order, so streams are
+    bit-identical — scheduling changes WHEN a request runs, never WHAT
+    it computes.
+    """
+
+    def __init__(self, engine: DecodeEngine, *, mode: str = "continuous",
+                 max_queue: int = 256, metrics: DecodeMetrics | None = None,
+                 writer=None):
+        if mode not in ("continuous", "static"):
+            raise ValueError(f"unknown mode {mode!r}; "
+                             "use 'continuous' | 'static'")
+        self.engine = engine
+        self.mode = mode
+        self.max_queue = max_queue
+        self.metrics = metrics if metrics is not None else DecodeMetrics()
+        self.writer = writer
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._closed = False
+        self._pending = {c: deque() for c in REQUEST_CLASSES}
+        self._free = list(range(engine.max_slots))
+        self._active: dict = {}
+        rows = engine.grid.rows
+        self._tokens = np.zeros(rows, np.int32)
+        self._positions = np.zeros(rows, np.int32)
+        #: admission order as (submit_seq, request_class) — the SLO
+        #: priority test hook
+        self.admit_log: list = []
+        self._seq = itertools.count()
+        self._emit_step = itertools.count()
+        events.emit("decode_start", mode=mode, max_slots=engine.max_slots,
+                    max_seq=engine.max_seq)
+        self._thread = threading.Thread(
+            target=self._loop,
+            name=f"DecodeScheduler-{next(_SCHED_IDS)}", daemon=True)
+        self._thread.start()
+
+    # -- client surface -----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               request_class: str = BEST_EFFORT) -> Future:
+        """Enqueue one request; the Future resolves to a `DecodeResult`.
+        `request_class` is a serve/router class: latency_sensitive jumps
+        the queue (TTFT), best_effort rides for throughput
+        (DECODE_SLO_TARGETS)."""
+        if request_class not in REQUEST_CLASSES:
+            raise ValueError(f"unknown request class {request_class!r}")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.engine.max_seq:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"> max_seq {self.engine.max_seq}")
+        req = _Request(prompt, int(max_new_tokens), request_class)
+        with self._lock:
+            if self._closed:
+                self.metrics.record_rejected("shutdown")
+                raise ShuttingDownError("decode scheduler is shutting down")
+            depth = sum(len(q) for q in self._pending.values())
+            if depth >= self.max_queue:
+                self.metrics.record_rejected("queue_full")
+                raise QueueFullError(
+                    f"decode queue full ({self.max_queue})")
+            self._pending[request_class].append((next(self._seq), req))
+            self.metrics.record_submitted(request_class)
+        self._work.set()
+        return req.future
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._pending.values())
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    @property
+    def free_slots(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting new work, let everything queued/in-flight
+        finish, then shut the thread down. False on timeout (close is
+        still performed)."""
+        with self._lock:
+            self._closed = True
+        deadline = time.monotonic() + timeout
+        ok = True
+        while time.monotonic() < deadline:
+            with self._lock:
+                empty = (not self._active
+                         and not any(self._pending.values()))
+            if empty:
+                break
+            time.sleep(0.005)
+        else:
+            ok = False
+        self.close()
+        return ok
+
+    def close(self) -> None:
+        """Reject new submissions, stop the loop, join the thread, fail
+        every unfinished future with ShuttingDownError. Idempotent."""
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        self._work.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+        orphans = []
+        with self._lock:
+            for q in self._pending.values():
+                orphans.extend(req for _, req in q)
+                q.clear()
+            orphans.extend(self._active.values())
+            self._active.clear()
+        for req in orphans:
+            if not req.future.done():
+                req.future.set_exception(
+                    ShuttingDownError("decode scheduler closed"))
+                self.metrics.record_failed()
+        events.emit("decode_stop", completed=self.metrics.completed,
+                    failed=self.metrics.failed)
+        if self.writer is not None:
+            self.metrics.emit(self.writer, next(self._emit_step),
+                              queue_depth=0, cache=self.engine.stats())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- scheduler loop -----------------------------------------------------
+
+    def _take_admissions(self) -> list:
+        """Pop (request, slot) assignments under the lock: LS queue fully
+        before BE (the TTFT priority), one free slot each."""
+        out = []
+        with self._lock:
+            while self._free:
+                for cls in (LATENCY_SENSITIVE, BEST_EFFORT):
+                    if self._pending[cls]:
+                        seq, req = self._pending[cls].popleft()
+                        req.slot = self._free.pop(0)
+                        self.admit_log.append((seq, cls))
+                        out.append(req)
+                        break
+                else:
+                    break
+        return out
+
+    def _admit(self, reqs: list) -> None:
+        first = self.engine.prefill([r.prompt for r in reqs],
+                                    [r.slot for r in reqs])
+        now = time.monotonic()
+        finished = []
+        with self._lock:
+            for r, tok in zip(reqs, first):
+                r.tokens.append(int(tok))
+                r.token_times.append(now)
+                ttft_ms = (now - r.t_submit) * 1e3
+                self.metrics.record_admitted(ttft_ms, r.request_class)
+                events.emit("decode_admit", slot=r.slot,
+                            request_class=r.request_class,
+                            slo_target=DECODE_SLO_TARGETS[r.request_class],
+                            prompt_len=int(r.prompt.size))
+                self._active[r.slot] = r
+                self._tokens[r.slot] = int(tok)
+                self._positions[r.slot] = r.prompt.size
+                if len(r.tokens) >= r.max_new_tokens:
+                    finished.append(r)
+            for r in finished:
+                self._finish_locked(r, now)
+
+    def _finish_locked(self, r, now: float) -> None:
+        slot = r.slot
+        self._active.pop(slot, None)
+        self._free.append(slot)
+        self._tokens[slot] = 0
+        self._positions[slot] = 0
+        latency_ms = (now - r.t_submit) * 1e3
+        wall = max(now - r.t_submit, 1e-9)
+        self.metrics.record_completed(latency_ms, len(r.tokens),
+                                      len(r.tokens) / wall)
+        events.emit("decode_evict", slot=slot, tokens=len(r.tokens),
+                    request_class=r.request_class)
+        r.future.set_result(DecodeResult(
+            tokens=list(r.tokens),
+            ttft_ms=(r.token_times[0] - r.t_submit) * 1e3,
+            latency_ms=latency_ms,
+            token_times=list(r.token_times),
+            request_class=r.request_class,
+            prompt_len=int(r.prompt.size)))
+
+    def _step(self) -> None:
+        nxt = self.engine.decode(self._tokens, self._positions)
+        now = time.monotonic()
+        with self._lock:
+            self.metrics.record_step(len(self._active))
+            finished = []
+            for slot in sorted(self._active):
+                r = self._active[slot]
+                tok = int(nxt[slot])
+                r.tokens.append(tok)
+                r.token_times.append(now)
+                self._positions[slot] += 1
+                self._tokens[slot] = tok
+                if len(r.tokens) >= r.max_new_tokens:
+                    finished.append(r)
+            for r in finished:
+                self._finish_locked(r, now)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.mode == "continuous" or not self._active:
+                    reqs = self._take_admissions()
+                    if reqs:
+                        self._admit(reqs)
+                if self._active:
+                    self._step()
+                    continue
+            except Exception:  # pragma: no cover - defensive
+                log.exception("decode scheduler step failed")
+                with self._lock:
+                    broken = list(self._active.values())
+                    self._active.clear()
+                    self._free = list(range(self.engine.max_slots))
+                for r in broken:
+                    if not r.future.done():
+                        r.future.set_exception(
+                            RuntimeError("decode step failed"))
+                        self.metrics.record_failed()
+                continue
+            with self._lock:
+                idle = not any(self._pending.values())
+            if idle:
+                self._work.wait(_IDLE_POLL_SECS)
+                self._work.clear()

@@ -161,3 +161,23 @@ def _with_shardings(abstract_tree, mesh, rules):
         lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
         abstract_tree, shd,
     )
+
+
+def init_lm_for_serving(model_name: str, *, seed: int = 0,
+                        **model_overrides):
+    """(model, params) for a registry causal LM (serve/decode.py).
+
+    Decode serving's loader seam: today the synthetic-token decode
+    workload always fresh-initializes from `seed` (mirroring
+    `load_for_serving`'s no-checkpoint fallback — deterministic, so two
+    replicas built with the same seed serve identical weights); a future
+    checkpoint-restored LM replaces only this function's body. Params
+    stay host-side — the decode engine owns placement the way
+    `InferenceEngine` does for bundles."""
+    model = get_model(model_name, **model_overrides)
+    if not hasattr(model, "decode_step"):
+        raise ValueError(
+            f"model {model_name!r} has no decode surface (decode_step/"
+            "prefill/init_cache) — decode serving needs a causal LM")
+    params, _state = model.init(jax.random.PRNGKey(seed))
+    return model, params

@@ -234,6 +234,144 @@ def _pct(lat: np.ndarray) -> dict:
     }
 
 
+def make_prompts(n: int, *, max_seq: int, seed: int = 0,
+                 min_prompt: int = 2, max_prompt: int | None = None,
+                 min_new: int = 1, max_new: int | None = None,
+                 vocab_size: int = 256):
+    """Seeded decode traffic: `n` (prompt, max_new_tokens) pairs whose
+    prompt lengths, token values, and output lengths are a fixed function
+    of the arguments — two runs (or two scheduling modes) see
+    byte-identical requests in the same order, the precondition for the
+    stream-identity comparison. Lengths always satisfy
+    ``prompt + max_new <= max_seq``."""
+    if max_prompt is None:
+        max_prompt = max(min_prompt, max_seq // 2)
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(min_prompt, max_prompt + 1))
+        hi = max_new if max_new is not None else max_seq - plen
+        hi = min(hi, max_seq - plen)
+        new = int(rng.integers(min_new, max(min_new, hi) + 1))
+        prompt = rng.integers(0, vocab_size, size=plen, dtype=np.int32)
+        out.append((prompt, new))
+    return out
+
+
+def run_decode_loadgen(
+    scheduler,
+    *,
+    n_requests: int,
+    concurrency: int,
+    seed: int = 0,
+    ls_fraction: float = 0.0,
+    min_prompt: int = 2,
+    max_prompt: int | None = None,
+    max_new: int | None = None,
+    timeout: float = 240.0,
+    keep_streams: bool = False,
+) -> dict:
+    """Drive a `serve/decode.DecodeScheduler` with seeded autoregressive
+    traffic; closed loop like `run_loadgen` (the semaphore window keeps
+    `concurrency` requests in flight, so continuous batching always has a
+    queue to admit from). Returns the decode SLO summary: TTFT
+    percentiles (submit -> first token), per-request generation
+    throughput (tokens / generation wall time), per-request token
+    timestamps, and the compile-cache miss delta across the timed
+    traffic (`recompiles_during_traffic` — 0 after prewarm is the
+    decode grid's no-recompile guarantee). `keep_streams` returns each
+    request's full token stream for mode-vs-mode identity checks."""
+    from dist_mnist_tpu.serve.router import (
+        BEST_EFFORT,
+        LATENCY_SENSITIVE,
+    )
+
+    reqs = make_prompts(n_requests, max_seq=scheduler.engine.max_seq,
+                        seed=seed, min_prompt=min_prompt,
+                        max_prompt=max_prompt, max_new=max_new,
+                        vocab_size=scheduler.engine.model.vocab_size)
+    rng = np.random.default_rng(seed + 1)
+    classes = np.where(rng.random(n_requests) < ls_fraction,
+                       LATENCY_SENSITIVE, BEST_EFFORT)
+    cache0 = scheduler.engine.stats()
+    window = threading.Semaphore(concurrency)
+    futures = []
+    rejected_queue_full = 0
+    rejected_shutdown = 0
+
+    for i, (prompt, new) in enumerate(reqs):
+        window.acquire()
+        try:
+            fut = scheduler.submit(prompt, new,
+                                   request_class=str(classes[i]))
+        except QueueFullError:
+            rejected_queue_full += 1
+            window.release()
+            continue
+        except ShuttingDownError:
+            rejected_shutdown += 1
+            window.release()
+            continue
+        fut.add_done_callback(lambda _f: window.release())
+        futures.append(fut)
+
+    ok = 0
+    errors = 0
+    ttfts = []
+    latencies = []
+    tokens_per_s = []
+    tokens_out = 0
+    streams = []
+    token_times = []
+    for fut in futures:
+        try:
+            res = fut.result(timeout=timeout)
+        except Exception:
+            errors += 1
+            continue
+        ok += 1
+        ttfts.append(res.ttft_ms)
+        latencies.append(res.latency_ms)
+        tokens_out += len(res.tokens)
+        wall_s = res.latency_ms / 1e3
+        tokens_per_s.append(len(res.tokens) / max(wall_s, 1e-9))
+        token_times.append(list(res.token_times))
+        if keep_streams:
+            streams.append(list(res.tokens))
+
+    ttft = np.asarray(ttfts, dtype=np.float64)
+    tps = np.asarray(tokens_per_s, dtype=np.float64)
+    summary = {
+        "n_requests": n_requests,
+        "concurrency": concurrency,
+        "mode": scheduler.mode,
+        "ok": ok,
+        "errors": errors,
+        "rejected_queue_full": rejected_queue_full,
+        "rejected_shutdown": rejected_shutdown,
+        "tokens_out": tokens_out,
+        "ttft_p50_ms": float(np.percentile(ttft, 50)) if ttft.size
+        else float("nan"),
+        "ttft_p99_ms": float(np.percentile(ttft, 99)) if ttft.size
+        else float("nan"),
+        "ttft_mean_ms": float(ttft.mean()) if ttft.size else float("nan"),
+        "tokens_per_s_p50": float(np.percentile(tps, 50)) if tps.size
+        else float("nan"),
+        "tokens_per_s_mean": float(tps.mean()) if tps.size
+        else float("nan"),
+        "token_times": token_times,
+    }
+    summary.update(_pct(np.asarray(latencies, dtype=np.float64)))
+    cache1 = scheduler.engine.stats()
+    summary["cache"] = cache1
+    summary["recompiles_during_traffic"] = \
+        cache1["misses"] - cache0["misses"]
+    summary["scheduler"] = scheduler.metrics.snapshot()
+    if keep_streams:
+        summary["streams"] = streams
+    return summary
+
+
 def run_fleet_loadgen(
     router,
     *,

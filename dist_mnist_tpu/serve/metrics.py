@@ -222,3 +222,137 @@ class ServeMetrics:
                              self.batch_occupancy.representative_values(),
                              step)
         writer.flush()
+
+
+class DecodeMetrics:
+    """Thread-safe accumulator for one `serve.decode.DecodeScheduler`.
+
+    Decode serving's two SLOs get their own signals (docs/OBSERVABILITY.md
+    `serve/decode_*` rows): **TTFT** (submit -> first token, the
+    latency_sensitive target) and **per-token throughput** (tokens /
+    generation wall time, the best_effort target). Slot occupancy per
+    decode step shows how full continuous batching keeps the machine —
+    the static baseline's tail-off between batches is visible here.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.submitted_latency_sensitive = 0
+        self.completed = 0
+        self.rejected_queue_full = 0
+        self.rejected_shutdown = 0
+        self.failed = 0
+        self.steps = 0
+        self.tokens_out = 0
+        self.ttft_ms = StreamingHistogram()
+        self.tokens_per_s = StreamingHistogram()
+        self.active_slots = StreamingHistogram()
+
+    def attach_to(self, registry) -> None:
+        """Expose the live ladders on a MetricRegistry (-> /metrics)."""
+        registry.attach_histogram("serve/decode_ttft_ms", self.ttft_ms)
+        registry.attach_histogram("serve/decode_tokens_per_s",
+                                  self.tokens_per_s)
+        registry.attach_histogram("serve/decode_active_slots",
+                                  self.active_slots)
+
+    def record_submitted(self, request_class: str):
+        with self._lock:
+            self.submitted += 1
+            if request_class == "latency_sensitive":
+                self.submitted_latency_sensitive += 1
+
+    def record_rejected(self, reason: str):
+        with self._lock:
+            if reason == "queue_full":
+                self.rejected_queue_full += 1
+            elif reason == "shutdown":
+                self.rejected_shutdown += 1
+            else:
+                raise ValueError(f"unknown rejection reason {reason!r}")
+
+    def record_admitted(self, ttft_ms: float, request_class: str):
+        self.ttft_ms.observe(ttft_ms)
+
+    def record_completed(self, latency_ms: float, n_tokens: int,
+                         tokens_per_s: float):
+        self.tokens_per_s.observe(tokens_per_s)
+        with self._lock:
+            self.completed += 1
+            self.tokens_out += n_tokens
+
+    def record_failed(self, n: int = 1):
+        with self._lock:
+            self.failed += n
+
+    def record_step(self, n_active: int):
+        """One decode step with `n_active` live slots (of max_slots)."""
+        self.active_slots.observe(n_active)
+        with self._lock:
+            self.steps += 1
+
+    def snapshot(self) -> dict:
+        """Point-in-time summary (plain floats/ints — JSON-safe for bench)."""
+        ttft = self.ttft_ms.snapshot()
+        tps = self.tokens_per_s.snapshot()
+        act = self.active_slots.snapshot()
+        with self._lock:
+            out = {
+                "submitted": self.submitted,
+                "submitted_latency_sensitive":
+                    self.submitted_latency_sensitive,
+                "completed": self.completed,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_shutdown": self.rejected_shutdown,
+                "failed": self.failed,
+                "steps": self.steps,
+                "tokens_out": self.tokens_out,
+            }
+        if ttft["count"]:
+            out["ttft_p50_ms"] = ttft["p50"]
+            out["ttft_p99_ms"] = ttft["p99"]
+            out["ttft_mean_ms"] = ttft["mean"]
+        if tps["count"]:
+            out["tokens_per_s_p50"] = tps["p50"]
+            out["tokens_per_s_mean"] = tps["mean"]
+        out["mean_active_slots"] = act["mean"] if act["count"] else 0.0
+        return out
+
+    def emit(self, writer, step: int, *, queue_depth: int | None = None,
+             cache: dict | None = None) -> None:
+        """Write the snapshot through an obs MetricWriter — one batched
+        `scalars()` call, same cadence convention as `ServeMetrics.emit`."""
+        snap = self.snapshot()
+        vals: dict[str, float] = {}
+        vals["serve/decode_submitted"] = snap["submitted"]
+        vals["serve/decode_completed"] = snap["completed"]
+        vals["serve/decode_rejected_queue_full"] = \
+            snap["rejected_queue_full"]
+        vals["serve/decode_rejected_shutdown"] = snap["rejected_shutdown"]
+        vals["serve/decode_failed"] = snap["failed"]
+        vals["serve/decode_steps"] = snap["steps"]
+        vals["serve/decode_tokens_out"] = snap["tokens_out"]
+        vals["serve/decode_mean_active_slots"] = snap["mean_active_slots"]
+        if "ttft_p50_ms" in snap:
+            vals["serve/decode_ttft_p50_ms"] = snap["ttft_p50_ms"]
+            vals["serve/decode_ttft_p99_ms"] = snap["ttft_p99_ms"]
+        if "tokens_per_s_mean" in snap:
+            vals["serve/decode_tokens_per_s"] = snap["tokens_per_s_mean"]
+        if queue_depth is not None:
+            vals["serve/decode_queue_depth"] = queue_depth
+        if cache:
+            vals["serve/cache_hits"] = cache.get("hits", 0)
+            vals["serve/cache_misses"] = cache.get("misses", 0)
+        batch_write = getattr(writer, "scalars", None)
+        if callable(batch_write):
+            batch_write(vals, step)
+        else:
+            for k, v in vals.items():
+                writer.scalar(k, v, step)
+        if self.ttft_ms.count:
+            writer.histogram("serve/decode_ttft_ms",
+                             self.ttft_ms.representative_values(), step)
+            writer.histogram("serve/decode_active_slots",
+                             self.active_slots.representative_values(), step)
+        writer.flush()

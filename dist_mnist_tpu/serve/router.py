@@ -91,6 +91,18 @@ LATENCY_SENSITIVE = "latency_sensitive"
 BEST_EFFORT = "best_effort"
 REQUEST_CLASSES = (LATENCY_SENSITIVE, BEST_EFFORT)
 
+#: What each request class optimizes for on the DECODE path
+#: (serve/decode.py): the same two classes the router sheds by map onto
+#: autoregressive SLOs — latency_sensitive requests jump the admission
+#: queue to minimize time-to-first-token, best_effort requests ride the
+#: in-flight batch for per-token throughput. Keyed here, beside the
+#: class constants, so the router and the decode scheduler can never
+#: disagree about what a class means.
+DECODE_SLO_TARGETS = {
+    LATENCY_SENSITIVE: "ttft_ms",
+    BEST_EFFORT: "tokens_per_s",
+}
+
 # conftest leak registry: every started-but-unclosed router is a leak (its
 # health/timer threads would outlive the test).
 _LIVE_ROUTERS: list = []

@@ -262,3 +262,134 @@ def build_zoo_engine(
         quant=quant or getattr(bundle, "quant", None),
         quant_report=getattr(bundle, "quant_report", None),
     )
+
+
+# ---------------------------------------------------------------------------
+# autoregressive decode grid (serve/decode.py executes it)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeGrid:
+    """The executable surface of the decode subsystem, planned up front.
+
+    Two program families (docs/SERVING.md "Autoregressive decode"):
+
+    - **prefill** cells, a (admit-bucket, prompt-bucket) grid exactly like
+      the classifier's (batch, seq) grid: prompts are right-padded to the
+      power-of-two ``prompt_buckets`` entry for THEIR OWN length (never
+      the batch's max — a request's prefill program must not depend on
+      who it was admitted with, or token streams would differ between
+      scheduling modes), and batched up to ``admit_buckets``.
+    - **one decode cell**: the single-token step is compiled once at the
+      full slot capacity (+1 scratch row prefill padding lands in) and
+      every step runs it — continuous batching admits/evicts by editing
+      the per-slot token/position vectors, never by reshaping the batch.
+
+    Prewarming every cell is what makes mixed prefill/decode traffic
+    recompile-free (the acceptance bar bench.py --serve --decode holds).
+    """
+
+    max_slots: int = 8
+    max_seq: int = 64
+    prompt_buckets: tuple = ()
+    admit_buckets: tuple = ()
+
+    def __post_init__(self):
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        pb = tuple(sorted({int(b) for b in self.prompt_buckets}))
+        if not pb or any(b < 1 or b > self.max_seq for b in pb):
+            raise ValueError(
+                f"prompt buckets {pb} must be within [1, {self.max_seq}]")
+        ab = tuple(sorted({int(b) for b in self.admit_buckets}))
+        if not ab or any(b < 1 for b in ab):
+            raise ValueError(f"admit buckets {ab} must be >= 1")
+        object.__setattr__(self, "prompt_buckets", pb)
+        object.__setattr__(self, "admit_buckets", ab)
+
+    @property
+    def rows(self) -> int:
+        """Device rows of the decode batch / KV cache: every slot plus
+        the scratch row that absorbs prefill padding writes."""
+        return self.max_slots + 1
+
+    def prompt_bucket_for(self, length: int) -> int:
+        """Smallest prompt bucket holding `length` — a function of the
+        request alone (see class docstring)."""
+        if length < 1:
+            raise ValueError("empty prompt")
+        for b in self.prompt_buckets:
+            if b >= length:
+                return b
+        raise ValueError(
+            f"prompt length {length} > largest bucket "
+            f"{self.prompt_buckets[-1]}")
+
+    def admit_bucket_for(self, n: int) -> int:
+        """Smallest admit (prefill batch) bucket holding `n` rows."""
+        if n < 1:
+            raise ValueError("empty admission")
+        for b in self.admit_buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"admission of {n} > largest admit bucket "
+            f"{self.admit_buckets[-1]}; chunk upstream")
+
+    def cells(self) -> list:
+        """Every compiled program: ('prefill', n, s) cells + ('decode',)."""
+        out = [("prefill", n, s) for n in self.admit_buckets
+               for s in self.prompt_buckets]
+        out.append(("decode",))
+        return out
+
+
+def default_decode_grid(model, *, max_slots: int = 8,
+                        prompt_buckets=None) -> DecodeGrid:
+    """Power-of-two prompt buckets up to the model's max_seq (floored at
+    4 tokens — tinier programs aren't worth their cache slots), admit
+    buckets up to the slot count."""
+    max_seq = int(model.max_seq)
+    if prompt_buckets is None:
+        buckets, b = [], 4
+        while b < max_seq:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_seq)
+    else:
+        buckets = [int(b) for b in prompt_buckets]
+    admits, a = [], 1
+    while a < max_slots:
+        admits.append(a)
+        a *= 2
+    admits.append(max_slots)
+    return DecodeGrid(max_slots=max_slots, max_seq=max_seq,
+                      prompt_buckets=tuple(buckets),
+                      admit_buckets=tuple(admits))
+
+
+def build_decode_engine(
+    mesh,
+    *,
+    model_name: str = "causal_tiny",
+    seed: int = 0,
+    max_slots: int = 8,
+    prompt_buckets=None,
+    store=None,
+    cache: CompiledModelCache | None = None,
+    **model_overrides,
+):
+    """Construct a fully-wired `serve/decode.DecodeEngine` for a registry
+    causal model — the decode-side sibling of `build_zoo_engine`. Params
+    are fresh-initialized from `seed` (the synthetic-token decode workload
+    has no checkpoint lineage yet; `loader.init_lm_for_serving` is the
+    seam a restore would slot into)."""
+    from dist_mnist_tpu.serve.decode import DecodeEngine
+    from dist_mnist_tpu.serve.loader import init_lm_for_serving
+
+    model, params = init_lm_for_serving(model_name, seed=seed,
+                                        **model_overrides)
+    grid = default_decode_grid(model, max_slots=max_slots,
+                               prompt_buckets=prompt_buckets)
+    return DecodeEngine(model, params, mesh, model_name=model_name,
+                        grid=grid, store=store, cache=cache)

@@ -17,6 +17,15 @@ accounting at each concurrency level:
 
     python scripts/serve_loadgen.py --fleet 3 --ls-fraction 0.8 \
         --ls-deadline-ms 500 --platform cpu --host-device-count 8
+
+``--decode`` switches to the autoregressive generator
+(`run_decode_loadgen`) against a `serve/decode.py` continuous-batching
+scheduler — TTFT percentiles and per-request token throughput at each
+concurrency level; ``--decode-mode static`` runs the static-batch
+baseline on the same compiled executables:
+
+    python scripts/serve_loadgen.py --decode --requests 64 \
+        --concurrency 4,16 --platform cpu --host-device-count 8
 """
 
 from __future__ import annotations
@@ -50,6 +59,15 @@ def main() -> int:
                     help="latency_sensitive fraction in --fleet mode")
     ap.add_argument("--ls-deadline-ms", type=float, default=None)
     ap.add_argument("--be-deadline-ms", type=float, default=None)
+    ap.add_argument("--decode", action="store_true",
+                    help="autoregressive decode mode: drive a "
+                         "serve/decode.py scheduler instead of the "
+                         "classifier server")
+    ap.add_argument("--decode-mode", default="continuous",
+                    choices=("continuous", "static"),
+                    help="scheduling mode in --decode mode")
+    ap.add_argument("--max-slots", type=int, default=8,
+                    help="in-flight sequence capacity in --decode mode")
     args = ap.parse_args()
 
     from dist_mnist_tpu.cluster import initialize_distributed
@@ -69,6 +87,8 @@ def main() -> int:
         run_loadgen,
     )
 
+    if args.decode:
+        return _decode_sweep(args)
     cfg = get_config(args.config)
     mesh = make_mesh(cfg.mesh)
     bundle = load_for_serving(cfg, mesh, checkpoint_dir=args.checkpoint_dir)
@@ -95,6 +115,40 @@ def main() -> int:
                 image_shape=bundle.image_shape,
                 seed=args.seed,
             )
+        print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+def _decode_sweep(args) -> int:
+    """Decode mode: fresh scheduler per concurrency level, one engine
+    (and therefore one compiled-program set + KV cache) across levels.
+    `token_times` is dropped from the printed summary — per-token
+    timestamps are a programmatic consumer's field, not a CLI one."""
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, make_mesh
+    from dist_mnist_tpu.serve import (
+        DecodeScheduler,
+        build_decode_engine,
+        run_decode_loadgen,
+    )
+
+    mesh = make_mesh(MeshSpec(data=-1))
+    engine = build_decode_engine(mesh, seed=args.seed,
+                                 max_slots=args.max_slots)
+    engine.prewarm()
+    for conc in (int(c) for c in args.concurrency.split(",")):
+        scheduler = DecodeScheduler(engine, mode=args.decode_mode,
+                                    max_queue=args.queue_depth)
+        try:
+            summary = run_decode_loadgen(
+                scheduler,
+                n_requests=args.requests,
+                concurrency=conc,
+                seed=args.seed,
+                ls_fraction=args.ls_fraction,
+            )
+        finally:
+            scheduler.close()
+        summary.pop("token_times", None)
         print(json.dumps(summary, sort_keys=True))
     return 0
 

@@ -74,7 +74,9 @@ def _no_leaked_prefetch_workers():
     serve/router.py's ``_LIVE_ROUTERS``, and cli/router.py's
     ``_LIVE_REPLICA_PROCS`` subprocess replicas), background zoo-grid
     prewarm threads (``ZooPrewarm`` — serve/server.py's async prewarm must
-    be joined by close()), and
+    be joined by close()), decode-scheduler threads (``DecodeScheduler`` —
+    serve/decode.py's continuous-batching loop must be joined by
+    close()/drain()), and
     warm-start/coldstart/journal temp dirs
     created OUTSIDE pytest's tmp root (launch()'s supervisor mkdtemp and
     bench.py's coldstart pair dir must clean up after themselves). Polls
@@ -106,6 +108,7 @@ def _no_leaked_prefetch_workers():
                        or t.name.startswith("ObsExporter")
                        or t.name.startswith("ZooPrewarm")
                        or t.name.startswith("ServeBatcher")
+                       or t.name.startswith("DecodeScheduler")
                        or t.name.startswith("LaunchPump")
                        or t.name.startswith("Router"))]
         exporter_mod = sys.modules.get("dist_mnist_tpu.obs.exporter")

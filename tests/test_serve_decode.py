@@ -1,0 +1,431 @@
+"""Tier-1 decode-serving tests (serve/decode.py + models/causal_lm.py).
+
+The subsystem's contracts, in dependency order: (1) the model's
+incremental decode is BITWISE the full-sequence forward at every
+position — including across the prefill/decode boundary and under a
+TP-sharded KV cache; (2) the engine's prefill result depends only on the
+request, never on the admission batch around it; (3) the scheduler's
+continuous batching changes WHEN a request runs, never WHAT it computes
+(identical token streams vs the static baseline), admits
+latency_sensitive ahead of queued best_effort, respects slot capacity,
+and never recompiles after prewarm. All CPU-mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dist_mnist_tpu.cluster.mesh import activate
+from dist_mnist_tpu.models.causal_lm import CausalLMTiny
+from dist_mnist_tpu.serve import (
+    BEST_EFFORT,
+    DECODE_SLO_TARGETS,
+    LATENCY_SENSITIVE,
+    CompiledModelCache,
+    DecodeMetrics,
+    DecodeScheduler,
+    QueueFullError,
+    ShuttingDownError,
+    build_decode_engine,
+    init_lm_for_serving,
+    make_prompts,
+    run_decode_loadgen,
+)
+from dist_mnist_tpu.serve.zoo import DecodeGrid, default_decode_grid
+
+# small geometry keeps the (admit x prompt) grid's CPU compiles fast
+LM_KW = dict(vocab_size=64, dim=32, depth=2, heads=4, max_seq=32)
+MAX_SLOTS = 4
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = CausalLMTiny(**LM_KW)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    """One compiled set for every engine in this module: engines differ
+    only in mesh/KV state, so cross-engine reuse is both a speedup and
+    itself a correctness claim (executables close over no weights)."""
+    return CompiledModelCache()
+
+
+@pytest.fixture(scope="module")
+def engine(mesh8, shared_cache):
+    eng = build_decode_engine(mesh8, max_slots=MAX_SLOTS,
+                              cache=shared_cache, **LM_KW)
+    eng.prewarm()
+    return eng
+
+
+def _prompts(n, seed=0, max_seq=LM_KW["max_seq"]):
+    return [p for p, _ in make_prompts(n, max_seq=max_seq, seed=seed,
+                                       max_new=1)]
+
+
+# -- model: bitwise decode==forward ------------------------------------------
+
+def test_incremental_decode_bit_matches_full_forward(lm):
+    model, params = lm
+    rng = np.random.default_rng(1)
+    s = 12
+    tokens = rng.integers(0, model.vocab_size, size=(2, s), dtype=np.int32)
+    full, _ = model.apply(params, {}, tokens)
+    full = np.asarray(full)
+    cache = model.init_cache(2)
+    for pos in range(s):
+        logits, cache = model.decode_step(
+            params, cache, tokens[:, pos], np.full(2, pos, np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(logits), full[:, pos],
+            err_msg=f"decode step at position {pos} is not bitwise the "
+                    f"full forward")
+
+
+def test_prefill_then_decode_boundary_bitwise(lm):
+    model, params = lm
+    rng = np.random.default_rng(2)
+    plen = 9
+    prompt = rng.integers(0, model.vocab_size, size=(1, plen),
+                          dtype=np.int32)
+    full, _ = model.apply(params, {}, prompt)
+    cache = model.init_cache(1)
+    last, cache = model.prefill(params, cache, prompt,
+                                np.zeros(1, np.int32),
+                                np.full(1, plen, np.int32))
+    np.testing.assert_array_equal(np.asarray(last), np.asarray(full)[:, -1])
+    # first decode step == full forward over (prompt + that token)
+    nxt = np.argmax(np.asarray(last), axis=-1).astype(np.int32)
+    step, cache = model.decode_step(params, cache, nxt,
+                                    np.full(1, plen, np.int32))
+    extended = np.concatenate([prompt, nxt[:, None]], axis=1)
+    full2, _ = model.apply(params, {}, extended)
+    np.testing.assert_array_equal(np.asarray(step),
+                                  np.asarray(full2)[:, plen])
+
+
+def test_prefill_padding_rows_do_not_perturb_real_rows(lm):
+    """A request's cache rows and logits are identical whether it
+    prefilled solo or padded into a batch with other prompts — the
+    model-level half of stream independence from scheduling."""
+    model, params = lm
+    rng = np.random.default_rng(3)
+    plen, bucket = 6, 8
+    prompt = np.zeros((1, bucket), np.int32)
+    prompt[0, :plen] = rng.integers(0, model.vocab_size, size=plen)
+    solo_last, solo_cache = model.prefill(
+        params, model.init_cache(3), prompt, np.asarray([1], np.int32),
+        np.asarray([plen], np.int32))
+    other = rng.integers(0, model.vocab_size, size=(1, bucket),
+                         dtype=np.int32)
+    batch = np.concatenate([other, prompt], axis=0)
+    both_last, both_cache = model.prefill(
+        params, model.init_cache(3), batch, np.asarray([0, 1], np.int32),
+        np.asarray([bucket, plen], np.int32))
+    np.testing.assert_array_equal(np.asarray(solo_last)[0],
+                                  np.asarray(both_last)[1])
+    np.testing.assert_array_equal(np.asarray(solo_cache["k"])[:, 1],
+                                  np.asarray(both_cache["k"])[:, 1])
+
+
+def test_tp_sharded_cache_bitwise_vs_unsharded(lm, mesh_tp):
+    """Full forward + an incremental decode under the TP mesh (heads
+    sharded over model=2) are bitwise the unsharded results."""
+    model, params = lm
+    rng = np.random.default_rng(4)
+    s = 8
+    tokens = rng.integers(0, model.vocab_size, size=(2, s), dtype=np.int32)
+    ref, _ = model.apply(params, {}, tokens)
+    ref_cache = model.init_cache(2)
+    ref_step, ref_cache = model.decode_step(
+        params, ref_cache, tokens[:, 0], np.zeros(2, np.int32))
+    with activate(mesh_tp):
+        tp_full, _ = model.apply(params, {}, tokens)
+        tp_cache = model.init_cache(2)
+        tp_step, tp_cache = model.decode_step(
+            params, tp_cache, tokens[:, 0], np.zeros(2, np.int32))
+    np.testing.assert_array_equal(np.asarray(tp_full), np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(tp_step), np.asarray(ref_step))
+    np.testing.assert_array_equal(np.asarray(tp_cache["k"]),
+                                  np.asarray(ref_cache["k"]))
+
+
+def test_tp_engine_streams_match_dp_engine(mesh8, mesh_tp, shared_cache):
+    """Whole-stack TP parity: the same traffic through a data=4 x model=2
+    engine (heads-sharded KV cache) and the pure-DP engine yields
+    identical token streams."""
+    def streams(mesh, cache):
+        eng = build_decode_engine(mesh, max_slots=MAX_SLOTS, cache=cache,
+                                  **LM_KW)
+        eng.prewarm()
+        sched = DecodeScheduler(eng)
+        try:
+            return run_decode_loadgen(sched, n_requests=6, concurrency=4,
+                                      seed=5, keep_streams=True)["streams"]
+        finally:
+            sched.close()
+
+    # TP mesh compiles its own programs: a separate cache keeps this
+    # module's shared DP cache key-space clean
+    assert streams(mesh8, shared_cache) == streams(
+        mesh_tp, CompiledModelCache())
+
+
+# -- engine: grid + zero recompiles ------------------------------------------
+
+def test_decode_grid_bucketing_and_cells():
+    grid = default_decode_grid(CausalLMTiny(**LM_KW), max_slots=MAX_SLOTS)
+    assert grid.rows == MAX_SLOTS + 1
+    assert grid.prompt_bucket_for(1) == grid.prompt_buckets[0]
+    assert grid.prompt_bucket_for(5) == 8
+    assert grid.prompt_bucket_for(32) == 32
+    with pytest.raises(ValueError):
+        grid.prompt_bucket_for(33)
+    assert grid.admit_bucket_for(3) == 4
+    cells = grid.cells()
+    assert cells[-1] == ("decode",)
+    assert len(cells) == (len(grid.admit_buckets)
+                          * len(grid.prompt_buckets) + 1)
+    with pytest.raises(ValueError):
+        DecodeGrid(max_slots=0, max_seq=32, prompt_buckets=(4,),
+                   admit_buckets=(1,))
+
+
+def test_prewarm_then_zero_hot_path_recompiles(engine, shared_cache):
+    assert engine.prewarm() == 0  # module fixture already compiled all
+    before = shared_cache.misses
+    sched = DecodeScheduler(engine)
+    try:
+        summary = run_decode_loadgen(sched, n_requests=12, concurrency=6,
+                                     seed=0)
+    finally:
+        sched.close()
+    assert summary["ok"] == 12
+    assert summary["recompiles_during_traffic"] == 0
+    assert shared_cache.misses == before
+
+
+def test_engine_prefill_groups_by_request_own_bucket(engine):
+    """Mixed prompt lengths in one admission still prefill through each
+    request's OWN prompt bucket (multiple executables), and the first
+    generated token matches a solo prefill of the same prompt."""
+    prompts = [np.arange(3, dtype=np.int32) % engine.model.vocab_size,
+               np.arange(14, dtype=np.int32) % engine.model.vocab_size]
+    together = engine.prefill(prompts, [0, 1])
+    solo = [engine.prefill([p], [i])[0] for i, p in enumerate(prompts)]
+    np.testing.assert_array_equal(together, np.asarray(solo))
+
+
+def test_init_lm_for_serving_rejects_non_lm():
+    with pytest.raises(ValueError, match="decode surface"):
+        init_lm_for_serving("mlp")
+
+
+# -- scheduler: continuous batching ------------------------------------------
+
+def test_continuous_and_static_streams_identical(mesh8, shared_cache):
+    def run(mode):
+        eng = build_decode_engine(mesh8, max_slots=MAX_SLOTS,
+                                  cache=shared_cache, **LM_KW)
+        eng.prewarm()
+        sched = DecodeScheduler(eng, mode=mode)
+        try:
+            return run_decode_loadgen(sched, n_requests=10, concurrency=6,
+                                      seed=7, keep_streams=True)
+        finally:
+            sched.close()
+
+    cont, stat = run("continuous"), run("static")
+    assert cont["streams"] == stat["streams"]
+    assert cont["ok"] == stat["ok"] == 10
+    assert cont["recompiles_during_traffic"] == 0
+    assert stat["recompiles_during_traffic"] == 0
+
+
+def test_slot_admit_evict_invariants(engine):
+    """More requests than slots: every admission gets a real slot, live
+    occupancy never exceeds capacity, every eviction returns its slot,
+    and the scheduler ends empty with all slots free."""
+    sched = DecodeScheduler(engine)
+    n = 3 * MAX_SLOTS
+    try:
+        futs = [sched.submit(p, 4) for p in _prompts(n, seed=8)]
+        results = [f.result(timeout=60) for f in futs]
+        assert sched.drain(timeout=30)
+    finally:
+        sched.close()
+    assert all(len(r.tokens) == 4 for r in results)
+    assert len(sched.admit_log) == n
+    # submissions were all enqueued before any admission cycle ran more
+    # than once, so admission order == submission order for one class
+    assert [seq for seq, _ in sched.admit_log] == sorted(
+        seq for seq, _ in sched.admit_log)
+    assert sched.active_count == 0
+    assert sched.free_slots == MAX_SLOTS
+    assert sched.queue_depth == 0
+    snap = sched.metrics.snapshot()
+    assert snap["completed"] == n
+    assert snap["mean_active_slots"] <= MAX_SLOTS
+
+
+def test_latency_sensitive_jumps_the_queue(engine):
+    """With every slot occupied and best_effort requests queued, a newly
+    submitted latency_sensitive request is admitted before ALL of them
+    (DECODE_SLO_TARGETS maps it to the TTFT target)."""
+    assert DECODE_SLO_TARGETS[LATENCY_SENSITIVE] == "ttft_ms"
+    assert DECODE_SLO_TARGETS[BEST_EFFORT] == "tokens_per_s"
+    sched = DecodeScheduler(engine)
+    try:
+        occupants = [sched.submit(p, 16) for p in _prompts(MAX_SLOTS,
+                                                           seed=9)]
+        # wait until every slot is genuinely occupied so the queue forms
+        deadline = time.monotonic() + 30
+        while sched.free_slots and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert sched.free_slots == 0
+        queued_be = [sched.submit(p, 2) for p in _prompts(3, seed=10)]
+        ls = sched.submit(_prompts(1, seed=11)[0], 2,
+                          request_class=LATENCY_SENSITIVE)
+        ls.result(timeout=60)
+        for f in occupants + queued_be:
+            f.result(timeout=60)
+        assert sched.drain(timeout=30)
+    finally:
+        sched.close()
+    post_occupancy = sched.admit_log[MAX_SLOTS:]
+    assert post_occupancy[0][1] == LATENCY_SENSITIVE
+    assert [cls for _, cls in post_occupancy[1:]] == [BEST_EFFORT] * 3
+
+
+def test_submit_validation_and_backpressure(engine):
+    sched = DecodeScheduler(engine, max_queue=2)
+    try:
+        with pytest.raises(ValueError, match="empty prompt"):
+            sched.submit(np.zeros(0, np.int32), 4)
+        with pytest.raises(ValueError, match="max_seq"):
+            sched.submit(np.zeros(30, np.int32), 8)
+        with pytest.raises(ValueError, match="request class"):
+            sched.submit(np.zeros(4, np.int32), 2, request_class="vip")
+        # saturate the slots (one at a time: max_queue=2 also caps how
+        # many un-admitted submissions may be pending), then the queue
+        blockers = []
+        deadline = time.monotonic() + 30
+        for p in _prompts(MAX_SLOTS, seed=12):
+            blockers.append(sched.submit(p, 16))
+            while sched.queue_depth and time.monotonic() < deadline:
+                time.sleep(0.002)
+        while sched.free_slots and time.monotonic() < deadline:
+            time.sleep(0.002)
+        queued = []
+        with pytest.raises(QueueFullError):
+            for p in _prompts(8, seed=13):
+                queued.append(sched.submit(p, 2))
+        assert sched.metrics.rejected_queue_full == 1
+        for f in blockers + queued:
+            f.result(timeout=60)
+    finally:
+        sched.close()
+
+
+def test_close_fails_pending_and_joins_thread(engine):
+    sched = DecodeScheduler(engine)
+    futs = [sched.submit(p, 16) for p in _prompts(2 * MAX_SLOTS, seed=14)]
+    sched.close()
+    with pytest.raises(ShuttingDownError):
+        sched.submit(np.zeros(4, np.int32), 2)
+    # every future settled: a result (finished before close) or the
+    # shutdown error (queued/in-flight at close) — never dropped
+    for f in futs:
+        assert f.done()
+        if f.exception() is not None:
+            assert isinstance(f.exception(), ShuttingDownError)
+    assert not any(t.name.startswith("DecodeScheduler")
+                   for t in threading.enumerate() if t.is_alive())
+    sched.close()  # idempotent
+
+
+# -- loadgen + metrics --------------------------------------------------------
+
+def test_decode_loadgen_deterministic(mesh8, shared_cache):
+    reqs_a = make_prompts(16, max_seq=32, seed=3)
+    reqs_b = make_prompts(16, max_seq=32, seed=3)
+    assert all((a == b).all() and na == nb
+               for (a, na), (b, nb) in zip(reqs_a, reqs_b))
+    assert all(p.size + n <= 32 for p, n in reqs_a)
+
+    def run():
+        eng = build_decode_engine(mesh8, max_slots=MAX_SLOTS,
+                                  cache=shared_cache, **LM_KW)
+        eng.prewarm()
+        sched = DecodeScheduler(eng)
+        try:
+            return run_decode_loadgen(sched, n_requests=8, concurrency=4,
+                                      seed=15, keep_streams=True)
+        finally:
+            sched.close()
+
+    a, b = run(), run()
+    assert a["streams"] == b["streams"]
+    assert a["tokens_out"] == b["tokens_out"] > 0
+    assert np.isfinite(a["ttft_p99_ms"]) and np.isfinite(
+        a["tokens_per_s_mean"])
+    # one token-timestamp list per completed request, one stamp per token
+    assert [len(t) for t in a["token_times"]] == [len(s)
+                                                  for s in a["streams"]]
+
+
+def test_decode_metrics_emit_batched_and_attached():
+    class Writer:
+        def __init__(self):
+            self.scalar_batches = []
+            self.hists = []
+
+        def scalars(self, vals, step):
+            self.scalar_batches.append((dict(vals), step))
+
+        def histogram(self, tag, values, step):
+            self.hists.append(tag)
+
+        def flush(self):
+            pass
+
+    class Registry:
+        def __init__(self):
+            self.attached = {}
+
+        def attach_histogram(self, tag, hist):
+            self.attached[tag] = hist
+
+    m = DecodeMetrics()
+    m.record_submitted(LATENCY_SENSITIVE)
+    m.record_admitted(12.5, LATENCY_SENSITIVE)
+    m.record_step(3)
+    m.record_completed(80.0, 8, 100.0)
+    m.record_rejected("queue_full")
+    reg = Registry()
+    m.attach_to(reg)
+    assert set(reg.attached) == {"serve/decode_ttft_ms",
+                                 "serve/decode_tokens_per_s",
+                                 "serve/decode_active_slots"}
+    w = Writer()
+    m.emit(w, 1, queue_depth=2, cache={"hits": 5, "misses": 1})
+    (vals, step), = w.scalar_batches
+    assert step == 1
+    assert vals["serve/decode_submitted"] == 1
+    assert vals["serve/decode_completed"] == 1
+    assert vals["serve/decode_rejected_queue_full"] == 1
+    assert vals["serve/decode_queue_depth"] == 2
+    assert vals["serve/decode_ttft_p99_ms"] == pytest.approx(12.5, rel=0.2)
+    assert vals["serve/decode_tokens_per_s"] == pytest.approx(100.0,
+                                                              rel=0.2)
+    assert "serve/decode_ttft_ms" in w.hists
+    with pytest.raises(ValueError):
+        m.record_rejected("bad_reason")
