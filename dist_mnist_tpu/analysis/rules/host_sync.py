@@ -45,6 +45,9 @@ HOT_PATH_TARGETS = (
     "dist_mnist_tpu/serve/loader.py",
     "dist_mnist_tpu/serve/decode.py",
     "dist_mnist_tpu/models/causal_lm.py",
+    # the Pallas kernel dispatch wrappers run per serve request / train
+    # step — a host sync there stalls the whole pipeline
+    "dist_mnist_tpu/ops/pallas/*.py",
 )
 
 

@@ -95,6 +95,27 @@ def _decode_attn_update(q, k_new, v_new, k_cache, v_cache, pos):
     return _attend(q, k_cache, v_cache, mask), k_cache, v_cache
 
 
+def _decode_attn_update_flash(q, k_new, v_new, k_cache, v_cache, pos):
+    """`_decode_attn_update` with the attention itself on the
+    variable-length Pallas flash kernel: the decode mask (``arange <=
+    pos``) is EXACTLY a key-prefix, so it becomes per-slot lengths
+    ``pos + 1`` and the kernel's grid skips cache blocks past each
+    slot's frontier — short sequences in a long `max_seq` cache stop
+    paying full-cache attention math. Opt-in (`attention_impl="flash"`):
+    the kernel's dot_general accumulation differs from `_attend`'s
+    broadcast-sum by ~1 ulp, so it relaxes the bit-exact decode==forward
+    contract to a tolerance (see tests/test_kernels.py)."""
+    from dist_mnist_tpu.ops.pallas.flash_attention import (
+        masked_flash_attention,
+    )
+
+    k_cache = _write_step(k_cache, k_new, pos)
+    v_cache = _write_step(v_cache, v_new, pos)
+    out = masked_flash_attention(q, k_cache, v_cache,
+                                 (pos + 1).astype(jnp.int32))
+    return out, k_cache, v_cache
+
+
 def _attend_gather(q, k, v, mask):
     """Shard-mapped body for the full-sequence forward: per-device local
     heads, then a tiled all_gather back to the full head axis so the
@@ -148,6 +169,13 @@ class CausalLMTiny:
     mlp_ratio: int = 4
     max_seq: int = 64
     compute_dtype: jnp.dtype = jnp.float32
+    # "xla" (default): broadcast-sum attention everywhere — decode
+    # bit-matches the full forward (tests/test_serve_decode.py contract).
+    # "flash": decode_step's cached attention runs the variable-length
+    # Pallas kernel (lengths = pos + 1, padded cache blocks skipped);
+    # prefill/apply keep the xla path (their causal mask is per-query,
+    # not key-only). Tolerance-parity, not bit-parity, vs "xla".
+    attention_impl: str = "xla"
 
     @property
     def head_dim(self) -> int:
@@ -156,6 +184,11 @@ class CausalLMTiny:
     def init(self, rng, sample_input=None):
         if self.dim % self.heads:
             raise ValueError(f"dim {self.dim} % heads {self.heads} != 0")
+        if self.attention_impl not in ("xla", "flash"):
+            raise ValueError(
+                f"unknown attention_impl {self.attention_impl!r}; "
+                "use 'xla' (bit-exact decode) or 'flash' (variable-length "
+                "Pallas decode attention)")
         keys = jax.random.split(rng, 3 + self.depth)
         d = self.dim
         params: dict = {
@@ -294,7 +327,12 @@ class CausalLMTiny:
         mesh = ambient_mesh()
         spec = _heads_spec(mesh, self.heads)
         if spec is None:
-            step = _decode_attn_update
+            # the TP shard_map path stays on _attend regardless of
+            # attention_impl: its contract is the gathered bit-stable
+            # output, and heads are already device-local there
+            step = (_decode_attn_update_flash
+                    if self.attention_impl == "flash"
+                    else _decode_attn_update)
         else:
             step = compat_shard_map(
                 _decode_attn_update_gather, mesh=mesh,

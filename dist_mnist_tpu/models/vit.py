@@ -185,12 +185,12 @@ class ViTTiny:
     def _attention(self, p, x, mask=None):
         if self.attention_impl == "xla":
             return nn.multi_head_attention(p, x, self.heads, mask=mask)
-        if mask is not None:
-            # the kernel impls (flash/ring/ulysses) take no mask argument;
+        if mask is not None and self.attention_impl != "flash":
+            # the ring/ulysses kernel impls take no mask argument;
             # serve/zoo.py degrades them to the native-length-only bucket
             raise ValueError(
                 f"attention_impl {self.attention_impl!r} does not support a "
-                "token mask; serve at native length or use 'xla'"
+                "token mask; serve at native length or use 'xla'/'flash'"
             )
         b, s, d = x.shape
         h = self.heads
@@ -199,10 +199,22 @@ class ViTTiny:
         if self.attention_impl == "flash":
             # mesh-adaptive: per-device local heads under a model axis
             # (a bare pallas_call would replicate — parallel/flash.py)
-            from dist_mnist_tpu.parallel.flash import flash_attention_sharded
+            from dist_mnist_tpu.parallel.flash import (
+                flash_attention_sharded,
+                masked_flash_attention_sharded,
+            )
 
-            out = flash_attention_sharded(q, k, v,
-                                          block_k=self.attention_block_k)
+            if mask is not None:
+                # zoo masks are key prefixes (real tokens first, then
+                # padding), so the variable-length kernel takes per-row
+                # LENGTHS and its grid skips fully-padded key blocks —
+                # sub-native buckets stop paying full-bucket math
+                lengths = jnp.sum(mask.astype(jnp.int32), axis=-1)
+                out = masked_flash_attention_sharded(
+                    q, k, v, lengths, block_k=self.attention_block_k)
+            else:
+                out = flash_attention_sharded(q, k, v,
+                                              block_k=self.attention_block_k)
         elif self.attention_impl in ("ring", "ring_flash"):
             from dist_mnist_tpu.parallel.ring_attention import ring_attention
 
@@ -374,7 +386,11 @@ class ViTTiny:
         actual token count — so a short input's logits equal running it at
         its own native bucket. `mask=None` (every training/eval call)
         compiles the exact historical program. Requires attention_impl
-        "xla" and no block pipeline; MoE note: padded tokens still occupy
+        "xla" (the -1e30 pre-softmax einsum) or "flash" (the
+        variable-length Pallas kernel — padded key BLOCKS are skipped by
+        the grid, so attention FLOPs scale with real length; see
+        ops/pallas/flash_attention.masked_flash_attention) and no block
+        pipeline; MoE note: padded tokens still occupy
         router capacity slots (shape-stable executables), which shows up in
         `moe_drop_fraction_metric` rather than corrupting real tokens."""
         x = x.astype(self.compute_dtype)

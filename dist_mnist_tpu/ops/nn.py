@@ -64,8 +64,8 @@ def init_dense(key, in_dim: int, out_dim: int, *, init=fan_in_trunc_normal) -> P
 def dense(p: Params, x: jax.Array) -> jax.Array:
     w = p["w"]
     if isinstance(w, QuantizedArray):
-        # weight-only int8 serve path: dequant fuses into the matmul's
-        # operand load, int8 is what HBM holds (ops/quant.py)
+        # weight-only int8 serve path: int8 is what HBM holds; q_dot
+        # dispatches fused-Pallas vs XLA-materialize (ops/quant.py)
         return q_dot(x, w) + p["b"].astype(x.dtype)
     return x @ w.astype(x.dtype) + p["b"].astype(x.dtype)
 

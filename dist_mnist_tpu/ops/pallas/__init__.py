@@ -10,7 +10,23 @@ implementation it is tested against.
 from dist_mnist_tpu.ops.pallas.flash_attention import (
     flash_attention,
     flash_attention_lse,
+    masked_flash_attention,
+    masked_flash_attention_probe,
+    masked_key_blocks,
 )
-from dist_mnist_tpu.ops.pallas.fused_adam import fused_adam_update
+from dist_mnist_tpu.ops.pallas.fused_adam import (
+    fused_adam_clip_wd_update,
+    fused_adam_update,
+)
+from dist_mnist_tpu.ops.pallas.quant_matmul import quant_matmul
 
-__all__ = ["flash_attention", "flash_attention_lse", "fused_adam_update"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_lse",
+    "fused_adam_clip_wd_update",
+    "fused_adam_update",
+    "masked_flash_attention",
+    "masked_flash_attention_probe",
+    "masked_key_blocks",
+    "quant_matmul",
+]

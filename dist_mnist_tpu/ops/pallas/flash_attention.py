@@ -509,6 +509,384 @@ _flash_attention_lse.defvjp(_flash_attention_lse_fwd,
                             _flash_attention_lse_bwd)
 
 
+# ---------------------------------------------------------------------------
+# key-padding-masked variable-length kernels
+#
+# The zoo's sub-native seq buckets (serve/zoo.py) and the decode cache
+# (models/causal_lm.py) both mask a PREFIX of the key axis per batch row:
+# row b attends keys [0, lengths[b]). The kernels below take that lengths
+# vector (int32, >= 1) through SMEM and make the streaming key-block grid
+# SKIP fully-padded blocks — a 64-token request in a 256 bucket runs 1/4
+# of the attention FLOPs instead of full-bucket math behind a -1e30 mask.
+# The skip predicate (`ki * block_k < lengths[bh]`) is the same expression
+# `masked_key_blocks` exposes for tests/bench FLOP attribution, and the
+# forward kernel counts its own active blocks into a `visits` output so
+# the scaling is asserted from INSIDE the kernel, not from prose.
+
+
+def _masked_attn_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                            vis_ref, m_scr, l_scr, acc_scr, cnt_scr,
+                            *, scale: float, block_k: int, nk: int):
+    """Online-softmax forward with per-row key lengths: grid (BH, nq, nk),
+    nk innermost ('arbitrary'). Identical math to `_attn_fwd_kernel_kt`
+    except the static `s_real` becomes `len_ref[bh]` and a whole key block
+    past the row's length is skipped, not just masked."""
+    s_real = len_ref[pl.program_id(0)]
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -1e30)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        cnt_scr[...] = jnp.zeros_like(cnt_scr)
+
+    @pl.when(ki * block_k < s_real)  # the skip: padded blocks do NO math
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        logits = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        logits = jnp.where(col < s_real, logits, -1e30)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_cur = jnp.maximum(m_prev, jnp.max(logits, axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(logits - m_cur[:, None])
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = m_cur
+        cnt_scr[...] = cnt_scr[...] + 1.0  # active-block probe
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[...] / l_scr[...][:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l_scr[...])
+        vis_ref[0] = jnp.broadcast_to(cnt_scr[...], vis_ref[0].shape)
+
+
+def _masked_attn_dq_kernel(len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, dq_ref, acc_scr, *, scale: float,
+                           block_k: int, nk: int):
+    """dQ with streamed keys and the forward's skip predicate replayed:
+    a skipped key block contributed no probability mass forward, so it
+    contributes no dq backward — skipping is exact, not approximate."""
+    s_real = len_ref[pl.program_id(0)]
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki * block_k < s_real)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        logits = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        logits = jnp.where(col < s_real, logits, -1e30)  # forward's mask
+        p = jnp.exp(logits - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        acc_scr[...] = acc_scr[...] + jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _masked_attn_dkv_kernel(len_ref, k_ref, v_ref, q_ref, do_ref, lse_ref,
+                            delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                            *, scale: float, bk_tile: int, nq: int):
+    """dK/dV with the query axis streamed (grid (BH, nk, nq), nq
+    innermost). A fully-padded key tile skips all math and finalizes to
+    exact zeros (a masked key's probability was zero forward, so its
+    gradient is zero); a PARTIAL tile row-masks the keys past the row's
+    length — unlike the unmasked kernels, padded keys here live inside
+    the array, not in a sliced-off tail."""
+    s_real = len_ref[pl.program_id(0)]
+    j = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(j * bk_tile < s_real)
+    def _tile():
+        k = k_ref[0].astype(jnp.float32)  # [bk_tile, D]
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        logits_t = jax.lax.dot_general(  # K_tile @ Q_tile^T
+            k, q, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p_t = jnp.exp(logits_t - lse[None, :])
+        row = j * bk_tile + jax.lax.broadcasted_iota(
+            jnp.int32, p_t.shape, 0)
+        p_t = jnp.where(row < s_real, p_t, 0.0)  # mask keys past length
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p_t, do, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_t = jax.lax.dot_general(
+            v, do, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds_t = p_t * (dp_t - delta[None, :])
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds_t, q, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "block_k", "interpret"))
+def _masked_flash_fwd_impl(q, k, v, lengths, block_q: int, block_k: int,
+                           interpret: bool):
+    """Returns (out [B,Sq,H,D], lse [B*H, q_pad], visits [B*H, q_pad]).
+    Cross-attention shapes allowed (decode: Sq=1 against a cached Sk)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = d**-0.5
+    s_pad = _round_up(sk, block_k)
+    q_pad = _round_up(sq, block_q)
+    qb = _to_bh(q, b, h, sq, d, q_pad)
+    kb = _to_bh(k, b, h, sk, d, s_pad)
+    vb = _to_bh(v, b, h, sk, d, s_pad)
+    len_bh = jnp.repeat(lengths.astype(jnp.int32), h)  # b-major, like _to_bh
+    nk = s_pad // block_k
+    out_shape = (
+        jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+        jax.ShapeDtypeStruct((b * h, q_pad), jnp.float32),
+        jax.ShapeDtypeStruct((b * h, q_pad), jnp.float32),
+    )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda i, j, ki: (i, j, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
+                           memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, block_q), lambda i, j, ki: (i, j),
+                            memory_space=pltpu.VMEM)
+    out, lse, visits = pl.pallas_call(
+        functools.partial(_masked_attn_fwd_kernel, scale=scale,
+                          block_k=block_k, nk=nk),
+        out_shape=out_shape,
+        grid=(b * h, q_pad // block_q, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths [B*H]
+            q_spec, kv_spec, kv_spec,
+        ],
+        out_specs=(q_spec, vec_spec, vec_spec),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((1,), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(dimension_semantics=_SEQ3),
+        interpret=interpret,
+    )(len_bh, qb, kb, vb)
+    return _from_bh(out, b, h, sq, d), lse, visits
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "block_k", "interpret"))
+def _masked_flash_bwd_impl(q, k, v, lengths, out, lse, do, block_q: int,
+                           block_k: int, interpret: bool):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = d**-0.5
+    s_pad = _round_up(sk, block_k)
+    q_pad = _round_up(sq, block_q)
+    qb = _to_bh(q, b, h, sq, d, q_pad)
+    kb = _to_bh(k, b, h, sk, d, s_pad)
+    vb = _to_bh(v, b, h, sk, d, s_pad)
+    ob = _to_bh(out, b, h, sq, d, q_pad)
+    dob = _to_bh(do, b, h, sq, d, q_pad)
+    len_bh = jnp.repeat(lengths.astype(jnp.int32), h)
+    delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1)
+    nk = s_pad // block_k
+    nq = q_pad // block_q
+
+    len_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    mat_tile_q = pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0),
+                              memory_space=pltpu.VMEM)
+    vec_spec_q = pl.BlockSpec((1, block_q), lambda i, j, kk: (i, j),
+                              memory_space=pltpu.VMEM)
+    kv_tile = pl.BlockSpec((1, block_k, d), lambda i, j, ki: (i, ki, 0),
+                           memory_space=pltpu.VMEM)
+    dqb = pl.pallas_call(
+        functools.partial(_masked_attn_dq_kernel, scale=scale,
+                          block_k=block_k, nk=nk),
+        out_shape=jax.ShapeDtypeStruct((b * h, q_pad, d), q.dtype),
+        grid=(b * h, nq, nk),
+        in_specs=[len_spec, mat_tile_q, kv_tile, kv_tile, mat_tile_q,
+                  vec_spec_q, vec_spec_q],
+        out_specs=mat_tile_q,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_CompilerParams(dimension_semantics=_SEQ3),
+        interpret=interpret,
+    )(len_bh, qb, kb, vb, dob, lse, delta)
+
+    mat_tile_k = pl.BlockSpec((1, block_k, d), lambda i, j, qq: (i, j, 0),
+                              memory_space=pltpu.VMEM)
+    q_tile_inner = pl.BlockSpec((1, block_q, d), lambda i, j, qi: (i, qi, 0),
+                                memory_space=pltpu.VMEM)
+    vec_tile_inner = pl.BlockSpec((1, block_q), lambda i, j, qi: (i, qi),
+                                  memory_space=pltpu.VMEM)
+    dkb, dvb = pl.pallas_call(
+        functools.partial(_masked_attn_dkv_kernel, scale=scale,
+                          bk_tile=block_k, nq=nq),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, s_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_pad, d), v.dtype),
+        ),
+        grid=(b * h, nk, nq),
+        in_specs=[len_spec, mat_tile_k, mat_tile_k, q_tile_inner,
+                  q_tile_inner, vec_tile_inner, vec_tile_inner],
+        out_specs=(mat_tile_k, mat_tile_k),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_CompilerParams(dimension_semantics=_SEQ3),
+        interpret=interpret,
+    )(len_bh, kb, vb, qb, dob, lse, delta)
+    return (_from_bh(dqb, b, h, sq, d), _from_bh(dkb, b, h, sk, d),
+            _from_bh(dvb, b, h, sk, d))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _masked_flash_attention(q, k, v, lengths, block_q: int, block_k: int,
+                            interpret: bool):
+    out, _, _ = _masked_flash_fwd_impl(q, k, v, lengths, block_q, block_k,
+                                       interpret)
+    return out
+
+
+def _masked_flash_attention_fwd(q, k, v, lengths, block_q: int,
+                                block_k: int, interpret: bool):
+    out, lse, _ = _masked_flash_fwd_impl(q, k, v, lengths, block_q, block_k,
+                                         interpret)
+    return out, (q, k, v, lengths, out, lse)
+
+
+def _masked_flash_attention_bwd(block_q: int, block_k: int, interpret: bool,
+                                res, do):
+    import numpy as np
+
+    q, k, v, lengths, out, lse = res
+    dq, dk, dv = _masked_flash_bwd_impl(q, k, v, lengths, out, lse, do,
+                                        block_q, block_k, interpret)
+    # int lengths take a float0 zero cotangent
+    dlen = np.zeros(np.shape(lengths), dtype=jax.dtypes.float0)
+    return dq, dk, dv, dlen
+
+
+_masked_flash_attention.defvjp(_masked_flash_attention_fwd,
+                               _masked_flash_attention_bwd)
+
+
+def masked_key_blocks(lengths, block_k: int):
+    """Active key blocks per batch row — ceil(length / block_k), the exact
+    skip predicate the kernels run (`ki * block_k < length`). Shared by
+    tests and `bench.py --kernels` so the reported FLOPs come from the
+    same expression as the kernel's grid skipping."""
+    lengths = jnp.asarray(lengths)
+    return -(-lengths // block_k)
+
+
+def masked_flash_flops(lengths, sq: int, heads: int, head_dim: int,
+                       block_k: int) -> float:
+    """Analytic forward FLOPs at block granularity: per row, 2 GEMMs
+    (scores + apply) over `active_blocks * block_k` keys — what the
+    masked kernel actually executes, scaling with REAL token length, vs
+    the -1e30 einsum's full-bucket `Sk` math."""
+    import numpy as np
+
+    active = np.asarray(masked_key_blocks(lengths, block_k)) * block_k
+    # lint: ok[host-sync] bench/test-side analytic count on host numpy
+    return float((2 * 2 * sq * head_dim * heads * active).sum())
+
+
+def _check_lengths_arg(k, lengths):
+    if lengths.ndim != 1 or lengths.shape[0] != k.shape[0]:
+        raise ValueError(
+            f"lengths must be [batch] = [{k.shape[0]}], got "
+            f"{lengths.shape}")
+
+
+def masked_flash_attention(q, k, v, lengths, *, block_q: int = 128,
+                           block_k: int = 128,
+                           interpret: bool | None = None):
+    """Variable-length attention: q ``[B,Sq,H,D]`` against k/v
+    ``[B,Sk,H,D]`` where row b attends only keys ``[0, lengths[b])``
+    (int32, 1 <= lengths[b] <= Sk — the key-prefix masks of zoo serving
+    and the decode cache). Equals the -1e30 pre-softmax einsum on the
+    same mask, but fully-padded key blocks are SKIPPED by the grid, so
+    the attention FLOPs scale with each row's real length instead of the
+    bucket ceiling. Differentiable (recompute-based custom VJP with the
+    same skipping); `interpret` auto-selects off-TPU so the CPU tier-1
+    mesh covers forward and backward."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_lengths_arg(k, lengths)
+    bq = _quantize_block_q(block_q, q.shape[1])
+    bk = min(_round_up(block_k, 128), _round_up(k.shape[1], 128))
+    return _masked_flash_attention(q, k, v, lengths, bq, bk, interpret)
+
+
+def masked_flash_attention_probe(q, k, v, lengths, *, block_q: int = 128,
+                                 block_k: int = 128,
+                                 interpret: bool | None = None):
+    """Forward-only variant returning ``(out, visits [B, H, Sq])``:
+    `visits` is the number of key blocks the kernel ACTUALLY entered per
+    query row, counted inside the kernel's skip predicate — the
+    structural evidence that masked buckets stop paying full-length
+    math. visits[b] == masked_key_blocks(lengths, block_k)[b] for every
+    head/row."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _check_lengths_arg(k, lengths)
+    b, sq, h, _ = q.shape
+    bq = _quantize_block_q(block_q, sq)
+    bk = min(_round_up(block_k, 128), _round_up(k.shape[1], 128))
+    out, _, visits = _masked_flash_fwd_impl(q, k, v, lengths, bq, bk,
+                                            interpret)
+    return out, visits[:, :sq].reshape(b, h, sq)
+
+
 def _quantize_block_q(block_q: int, s: int) -> int:
     # 128-align the q tile in BOTH directions (round a small/odd block_q
     # UP, cap at the padded sequence): the LSE rides the lane axis in the

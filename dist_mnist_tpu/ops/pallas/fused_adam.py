@@ -67,3 +67,65 @@ def fused_adam_update(grad, m, v, lr_t, *, b1: float = 0.9, b2: float = 0.999,
     )(jnp.reshape(lr_t, (1,)).astype(jnp.float32), as2d(grad), as2d(m), as2d(v))
     unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
     return unflat(delta), unflat(m2), unflat(v2)
+
+
+def _adam_clip_wd_kernel(sc_ref, g_ref, m_ref, v_ref, p_ref, d_ref, mo_ref,
+                         vo_ref, *, b1: float, b2: float, eps: float):
+    """`_adam_kernel` + global-norm clip + decoupled weight decay in the
+    SAME pass: sc_ref (SMEM) holds [lr_t, clip_scale, lr*wd]. The clip
+    scale multiplies the gradient BEFORE the moments (exactly
+    `clip_by_global_norm >> adam` chaining) and the decay subtracts
+    `lr*wd*p` from the delta (exactly adamw's decoupled term) — one HBM
+    pass instead of three kernel launches reading grad/param again."""
+    g = g_ref[:].astype(jnp.float32) * sc_ref[1]
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    mo_ref[:] = m
+    vo_ref[:] = v
+    d_ref[:] = (-sc_ref[0] * m / (jnp.sqrt(v) + eps)
+                - sc_ref[2] * p_ref[:].astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps"))
+def fused_adam_clip_wd_update(grad, m, v, param, lr_t, clip_scale, wd_step,
+                              *, b1: float = 0.9, b2: float = 0.999,
+                              eps: float = 1e-8):
+    """One-pass clip + Adam + decoupled weight decay for a single tensor.
+
+    Returns (delta, new_m, new_v). `clip_scale` is the global-norm clip
+    factor (min(1, max_norm/norm) — computed ONCE across the whole tree by
+    the caller, since the norm is a cross-tensor reduction a per-leaf
+    kernel cannot see); `wd_step` is `lr * weight_decay`. With
+    clip_scale=1 and wd_step=0 this is mathematically `fused_adam_update`
+    plus two no-op FMAs — `optim.fused_adamw` routes to the exact original
+    kernel in that case so the off-path stays bit-identical."""
+    shape, dtype = grad.shape, jnp.float32
+    n = math.prod(shape) if shape else 1
+    rows = max(1, math.ceil(n / _LANES))
+    pad = rows * _LANES - n
+    as2d = lambda x: jnp.pad(
+        x.astype(jnp.float32).reshape(-1), (0, pad)
+    ).reshape(rows, _LANES)
+    block_rows = min(_ROWS, rows)
+    grid = (math.ceil(rows / block_rows),)
+    tile = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((rows, _LANES), dtype)
+    scalars = jnp.stack([
+        jnp.asarray(lr_t, jnp.float32).reshape(()),
+        jnp.asarray(clip_scale, jnp.float32).reshape(()),
+        jnp.asarray(wd_step, jnp.float32).reshape(()),
+    ])
+    delta, m2, v2 = pl.pallas_call(
+        functools.partial(_adam_clip_wd_kernel, b1=b1, b2=b2, eps=eps),
+        out_shape=(out_shape, out_shape, out_shape),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # [lr_t, clip, lr*wd]
+            tile, tile, tile, tile,
+        ],
+        out_specs=(tile, tile, tile),
+        interpret=jax.default_backend() != "tpu",
+    )(scalars, as2d(grad), as2d(m), as2d(v), as2d(param))
+    unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
+    return unflat(delta), unflat(m2), unflat(v2)

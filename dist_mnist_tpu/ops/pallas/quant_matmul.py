@@ -1,0 +1,129 @@
+"""Fused int8 dequant-matmul Pallas kernel for the weight-only serve path.
+
+The XLA path (`ops/quant.q_dot` fallback) materializes a full float copy
+of the int8 kernel before the matmul — HBM reads the weight TWICE (once
+int8, once at compute width) and a transient float tensor exists at all.
+This kernel streams the int8 tiles straight from HBM into VMEM (half/quarter
+the weight bytes of bf16/f32), upcasts in registers, accumulates the GEMM
+in f32 on the MXU, and applies the per-output-channel scale ONCE to the
+f32 accumulator at the epilogue — dequant commutes with the contraction
+(`sum_k x[m,k] * (q[k,h] * s[h]) == s[h] * sum_k x[m,k] * q[k,h]`), so the
+scale never touches HBM-resident data.
+
+Grid: (M/bm, H/bn), both parallel; the contraction axis stays RESIDENT per
+tile (this repo's weights top out at D=768, so an int8 [D, 128] tile is
+<=96 KiB and an f32 [128, D] activation tile <=384 KiB — far inside the
+~16 MiB/core VMEM; see docs/PERF.md "Kernels" for the budget math). A
+K-streamed third grid dimension is the obvious extension for D beyond a
+few thousand.
+
+Scale layout contract (ops/quant.py): a 2-D kernel [D, H] carries scales
+[1, H]; "tensor"-mode leaves broadcast their single scale to the same
+[1, H] shape, so ONE kernel serves both modes. Stacked scan/MoE leaves
+([L, D, 3D] with [L, 1, 3D] scales, [E, D, H] with [E, 1, H]) reach this
+kernel already sliced to 2-D — `lax.scan` slices the leading dim away and
+`vmap` batches the kernel via the pallas batching rule (grid dim added).
+
+`interpret=True` (auto off-TPU) runs the same kernel under the Pallas
+interpreter so the CPU tier-1 mesh covers it; parity vs the XLA reference
+is gated in tests/test_kernels.py and `bench.py --kernels`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BM = 128  # activation rows per tile (MXU-sized)
+_BN = 128  # output channels per tile (lane width)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)        # [bm, D] activations
+    w = q_ref[...].astype(jnp.float32)        # [D, bn] int8 -> f32 in regs
+    acc = jax.lax.dot_general(                # f32 MXU accumulation
+        x, w, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # per-output-channel scale on the f32 accumulator — dequant commutes
+    # with the contraction, so this is the whole dequantize
+    o_ref[...] = (acc * s_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _qmm_2d(x, w_q, w_scale, interpret: bool):
+    m, d = x.shape
+    h = w_q.shape[1]
+    # 16-row granule covers both f32 (8) and bf16 (16) sublane tiles;
+    # the row pad must then reach a whole number of bm-row tiles
+    bm = min(_BM, _round_up(m, 16))
+    mp, hp = _round_up(m, bm), _round_up(h, _BN)
+    xp = jnp.pad(x, ((0, mp - m), (0, 0)))
+    qp = jnp.pad(w_q, ((0, 0), (0, hp - h)))
+    # padded channels get scale 0 -> exact zeros, sliced off below
+    sp = jnp.pad(w_scale.reshape(1, h), ((0, 0), (0, hp - h)))
+    out = pl.pallas_call(
+        _qmm_kernel,
+        out_shape=jax.ShapeDtypeStruct((mp, hp), x.dtype),
+        grid=(mp // bm, hp // _BN),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, _BN), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BN), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, _BN), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(xp, qp, sp)
+    return out[:m, :h]
+
+
+def quant_matmul(x, w_q, w_scale, *, interpret: bool | None = None):
+    """`x @ (w_q * w_scale)` without materializing the float weight.
+
+    x ``[..., D]`` float, w_q ``[D, H]`` int8, w_scale ``[1, H]`` (or
+    ``[H]``) f32 — the `QuantizedArray` 2-D layout, covering both
+    "channel" and broadcast "tensor" scales. Leading activation dims are
+    flattened into the row axis; rows/channels are padded to tile
+    multiples inside the jit (XLA fuses the pads) and sliced back off.
+    Returns x.dtype, accumulation in f32."""
+    if w_q.ndim != 2:
+        raise ValueError(
+            f"quant_matmul wants a 2-D int8 kernel, got {w_q.shape}; "
+            "stacked leaves are sliced by scan/vmap before dispatch")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    m = math.prod(lead) if lead else 1
+    out = _qmm_2d(x.reshape(m, x.shape[-1]), w_q, w_scale, interpret)
+    return out.reshape(*lead, w_q.shape[1])
+
+
+def quant_matmul_cost(x_shape, w_shape, x_dtype=jnp.float32) -> dict:
+    """Analytic roofline inputs for one `quant_matmul` call: MACs x2 FLOPs
+    and the HBM bytes the kernel actually moves (int8 weights + f32 scales
+    + activations in/out at compute width) — the numerator pair for
+    `bench.py --kernels` achieved-vs-peak attribution."""
+    d, h = (int(s) for s in w_shape)
+    m = math.prod(int(s) for s in x_shape[:-1]) or 1
+    act = jnp.dtype(x_dtype).itemsize
+    return {
+        "flops": 2.0 * m * d * h,
+        # lint: ok[host-sync] pure python-int arithmetic, no device values
+        "hbm_bytes": float(m * d * act      # activations in
+                           + d * h          # int8 weight tiles
+                           + 4 * h          # f32 scales
+                           + m * h * act),  # output
+    }

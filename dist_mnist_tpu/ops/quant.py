@@ -4,9 +4,13 @@ The serve-side memory budget (serve/engine.py `CompiledModelCache`) rations
 resident bytes; a bf16/f32 checkpoint spends 2-4x more of that budget than
 inference accuracy needs. This module provides the standard weight-only
 answer: matmul/conv kernels live in HBM as int8 with float32 per-channel
-scales, and the dequantize (`q * scale`) is emitted INSIDE the traced
-matmul so XLA fuses it into the operand load — activations, biases, norms,
-embeddings, and the MoE router gate stay float.
+scales, and the consuming contraction dequantizes on the fly — either the
+fused Pallas kernel (`ops/pallas/quant_matmul.py`: int8 tiles streamed
+from HBM, scales applied in registers, f32 accumulation; the TPU default)
+or the XLA fallback that materializes a transient float copy inside the
+traced matmul (`q_dot`/`q_einsum` pick per call, see `fused_matmul_mode`).
+Activations, biases, norms, embeddings, and the MoE router gate stay
+float.
 
 Representation: `QuantizedArray`, a registered pytree-with-keys node whose
 children are `(q: int8, scale: float32)` and whose aux data is the quant
@@ -33,6 +37,8 @@ scripts/check_host_sync.py's lint scope.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -151,14 +157,71 @@ def materialize(w, dtype=None):
     return w
 
 
-def q_dot(x, w: QuantizedArray):
-    """x @ dequant(w) with the dequant fused into the matmul's operand
-    load; accumulates in x's compute dtype like the float path."""
+#: fused-matmul dispatch mode — "auto" (Pallas kernel on TPU, XLA
+#: materialize elsewhere), "pallas" (force the kernel; interpret-mode off
+#: TPU — what tests and `bench.py --kernels` use), "xla" (force the
+#: materialize fallback). Read ONCE per trace: q_dot inside an already-
+#: compiled program keeps the dispatch it was traced with.
+FUSED_MATMUL = os.environ.get("DMT_QUANT_MATMUL", "auto")
+
+
+def _use_fused_matmul() -> bool:
+    if FUSED_MATMUL == "pallas":
+        return True
+    if FUSED_MATMUL == "xla":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def q_dot(x, w):
+    """``x @ w`` for either weight representation.
+
+    A plain float array multiplies untouched (bit-identical float
+    baseline). A `QuantizedArray` dispatches on `fused_matmul_mode`
+    semantics (module var `FUSED_MATMUL`): the DEFAULT on TPU is the
+    fused Pallas kernel (ops/pallas/quant_matmul.py) — int8 weight tiles
+    streamed from HBM, per-channel scales applied in registers, f32
+    accumulation; everywhere else (and under ``"xla"``) the fallback
+    MATERIALIZES a transient float dequant copy and lets XLA fold it into
+    the matmul — the weight is read at full compute width. Stacked
+    scan/MoE leaves arrive here already sliced to 2-D (scan slices the
+    leading dim; vmap batches the kernel), so both layouts hit the same
+    dispatch."""
+    if not isinstance(w, QuantizedArray):
+        return x @ w.astype(x.dtype)
+    if w.ndim == 2 and _use_fused_matmul():
+        from dist_mnist_tpu.ops.pallas.quant_matmul import quant_matmul
+
+        return quant_matmul(x, w.q, w.scale)
     return x @ dequantize(w, x.dtype)
 
 
+def _matmul_spec(spec: str):
+    """Parse an einsum spec that is exactly a last-axis matmul
+    (``...k,kh->...h`` shapes, arbitrary labels): returns True when the
+    second operand is 2-D, contracts its first axis with the first
+    operand's last, and the output is the first operand's leading labels
+    + the second's output label."""
+    spec = spec.replace(" ", "")
+    if "->" not in spec or spec.count(",") != 1:
+        return False
+    lhs, out = spec.split("->")
+    a, b = lhs.split(",")
+    if len(b) != 2 or "." in b or len(set(a)) != len(a):
+        return False
+    k, h = b
+    return bool(a) and a[-1] == k and h not in a and out == a[:-1] + h
+
+
 def q_einsum(spec: str, x, w: QuantizedArray):
-    """einsum twin of `q_dot` for non-matmul contractions."""
+    """einsum twin of `q_dot`. Specs that are a plain last-axis matmul in
+    disguise take the same fused-vs-materialize dispatch as `q_dot`;
+    genuinely non-matmul contractions always use the XLA fallback."""
+    if (isinstance(w, QuantizedArray) and w.ndim == 2
+            and _matmul_spec(spec) and _use_fused_matmul()):
+        from dist_mnist_tpu.ops.pallas.quant_matmul import quant_matmul
+
+        return quant_matmul(x, w.q, w.scale)
     return jnp.einsum(spec, x, dequantize(w, x.dtype))
 
 

@@ -23,7 +23,7 @@ from dist_mnist_tpu.optim.base import (
     add_decayed_weights,
     global_norm,
 )
-from dist_mnist_tpu.optim.adam import adam, adamw
+from dist_mnist_tpu.optim.adam import adam, adamw, fused_adamw
 from dist_mnist_tpu.optim.sgd import sgd, momentum
 from dist_mnist_tpu.optim.sync import gradient_accumulation
 from dist_mnist_tpu.optim import schedules
@@ -39,6 +39,7 @@ __all__ = [
     "global_norm",
     "adam",
     "adamw",
+    "fused_adamw",
     "sgd",
     "momentum",
     "gradient_accumulation",

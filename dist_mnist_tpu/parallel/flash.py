@@ -27,7 +27,10 @@ from dist_mnist_tpu.cluster.mesh import (
     ambient_mesh,
     compat_shard_map,
 )
-from dist_mnist_tpu.ops.pallas.flash_attention import flash_attention
+from dist_mnist_tpu.ops.pallas.flash_attention import (
+    flash_attention,
+    masked_flash_attention,
+)
 
 log = logging.getLogger(__name__)
 
@@ -87,3 +90,44 @@ def flash_attention_sharded(q, k, v, block_k=None):
         functools.partial(flash_attention, block_k=block_k),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
     return fn(q, k, v)
+
+
+def masked_flash_attention_sharded(q, k, v, lengths, block_k=None):
+    """Variable-length twin of `flash_attention_sharded`: row b attends
+    keys [0, lengths[b]) and the kernel grid skips fully-padded key blocks
+    (ops/pallas/flash_attention.masked_flash_attention). Same mesh policy —
+    plain kernel without a >1 model axis, shard_map over heads with one;
+    `lengths` [B] follows the batch placement (sharded over data exactly
+    when q/k/v batch rides the data axis, else replicated)."""
+    kw = {} if block_k is None else {"block_k": block_k}
+    mesh = ambient_mesh()
+    shape = getattr(mesh, "shape", {}) if mesh is not None else {}
+    m = shape.get(MODEL_AXIS, 1)
+    if m <= 1:
+        return masked_flash_attention(q, k, v, lengths, **kw)
+    heads = q.shape[2]
+    if heads % m:
+        raise ValueError(
+            f"flash attention on a {m}-way model axis shards the kernel "
+            f"over heads (Megatron TP attention) and cannot split a head: "
+            f"heads={heads} % model={m} != 0. Use a head count divisible "
+            f"by {m}, or attention_impl='xla' (einsums partition without "
+            "head granularity)."
+        )
+    data = shape.get(DATA_AXIS, 1)
+    batch_rides_data = data > 1 and q.shape[0] % data == 0
+    if data > 1 and not batch_rides_data:
+        log.warning(
+            "flash attention: batch=%d %% data axis %d != 0 — the kernel "
+            "drops the data axis and every device recomputes the FULL "
+            "replicated batch (%dx redundant compute/memory); use a batch "
+            "divisible by %d to ride the data axis",
+            q.shape[0], data, data, data,
+        )
+    batch_axis = DATA_AXIS if batch_rides_data else None
+    spec = P(batch_axis, None, MODEL_AXIS, None)
+    len_spec = P(batch_axis)
+    fn = compat_shard_map(
+        lambda q, k, v, lens: masked_flash_attention(q, k, v, lens, **kw),
+        mesh=mesh, in_specs=(spec, spec, spec, len_spec), out_specs=spec)
+    return fn(q, k, v, lengths)

@@ -58,7 +58,7 @@ from dist_mnist_tpu.cluster.mesh import (
     compat_shard_map,
 )
 from dist_mnist_tpu.ops.nn import fan_in_trunc_normal
-from dist_mnist_tpu.ops.quant import materialize
+from dist_mnist_tpu.ops.quant import q_dot
 
 
 def init_moe(key, dim: int, hidden: int, n_experts: int):
@@ -130,10 +130,12 @@ def _route(gate_w, x, n_experts: int, capacity: int, top_k: int = 1):
 
 
 def _expert_ffn(w1, b1, w2, b2, tokens):
-    # materialize() is identity on float weights (bit-identical baseline);
-    # int8-served expert stacks dequantize into the matmul (ops/quant.py)
-    h = jax.nn.relu(tokens @ materialize(w1, tokens.dtype) + b1)
-    return h @ materialize(w2, tokens.dtype) + b2
+    # q_dot is a plain matmul on float weights (bit-identical baseline);
+    # int8-served expert stacks take its fused-Pallas vs XLA-materialize
+    # dispatch (ops/quant.py) — vmap over the stacked [E, D, H] leaves
+    # batches the Pallas kernel, scan/all_to_all paths arrive pre-sliced
+    h = jax.nn.relu(q_dot(tokens, w1) + b1)
+    return q_dot(h, w2) + b2
 
 
 def moe_ffn_dense(params, x, capacity_factor: float = 1.25, top_k: int = 1):

@@ -153,15 +153,18 @@ def parse_seq_buckets(spec: str | None, image_shape,
 
 def supports_mask(model) -> bool:
     """True when `model.apply` can honor a token mask: it takes a `mask`
-    kwarg AND its attention path is the maskable einsum one ("xla" — the
-    Pallas/ring/ulysses kernels take no mask argument). Models without
-    mask support degenerate to the native-only grid."""
+    kwarg AND its attention path is maskable — "xla" (the -1e30
+    pre-softmax einsum) or "flash" (the variable-length Pallas kernel,
+    which turns the zoo's key-prefix masks into per-row lengths and SKIPS
+    fully-padded key blocks — ops/pallas/flash_attention). The
+    ring/ulysses kernels take no mask argument; models without mask
+    support degenerate to the native-only grid."""
     try:
         if "mask" not in inspect.signature(model.apply).parameters:
             return False
     except (TypeError, ValueError):
         return False
-    if getattr(model, "attention_impl", "xla") != "xla":
+    if getattr(model, "attention_impl", "xla") not in ("xla", "flash"):
         return False
     if getattr(model, "block_pipeline", 0):
         return False

@@ -33,6 +33,26 @@ def device_peak_flops(device: jax.Device | None = None) -> float | None:
     return PEAK_BF16_FLOPS.get(device.device_kind)
 
 
+# Peak HBM bandwidth per chip (bytes/s), same public TPU system docs and
+# same device_kind keys — the denominator for the memory-bound side of the
+# roofline (`bench.py --kernels` achieved-vs-peak attribution).
+PEAK_HBM_BYTES: dict[str, float] = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5": 2765e9,        # v5p
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,   # v6e / Trillium
+    "TPU v6e": 1640e9,
+}
+
+
+def device_peak_hbm_bytes(device: jax.Device | None = None) -> float | None:
+    """HBM bandwidth peak for `device`; None off-table (CPU/GPU/unknown) —
+    achieved-vs-peak fractions are then reported as null, never guessed."""
+    device = device or jax.devices()[0]
+    return PEAK_HBM_BYTES.get(device.device_kind)
+
+
 def analytic_step_flops(model, sample_shape, batch: int,
                         bwd_multiplier: float = 2.0) -> float | None:
     """Analytic training-step FLOPs: batch x (1 + bwd_multiplier) x the

@@ -62,6 +62,7 @@ run_stage bench_serve_fleet 900 python bench.py --serve --fleet --deadline 800
 run_stage bench_serve_longctx 900 python bench.py --serve --longctx --deadline 800
 run_stage bench_serve_quant 900 python bench.py --serve --quant --deadline 800
 run_stage bench_serve_decode 900 python bench.py --serve --decode --requests 64 --concurrency 16 --deadline 800
+run_stage bench_kernels  900 python bench.py --kernels --deadline 800
 run_stage bench_input     900 python bench.py --input --steps 200 --deadline 800
 run_stage bench_memory    900 python bench.py --memory --deadline 800
 run_stage bench_faults    900 python bench.py --faults --deadline 800

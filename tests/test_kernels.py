@@ -1,0 +1,368 @@
+"""Parity + structure gates for the serve-hot-path Pallas kernels.
+
+Every kernel in ops/pallas runs here in interpret mode against its
+pure-XLA reference:
+
+- `quant_matmul` (fused int8 dequant-matmul) vs `q_dot`'s materialize
+  path — 2-D/3-D activations, the stacked scan (`[L, D, 3D]` with
+  `[L, 1, 3D]` scales) and MoE (`[E, D, H]` with `[E, 1, H]` scales)
+  leaf layouts, the per-tensor fallback mode, and bf16 activations.
+- `masked_flash_attention` (variable-length key-prefix flash) vs the
+  `-1e30` pre-softmax einsum — every zoo (batch, seq) bucket shape,
+  bf16 tolerances, forward AND backward (custom VJP), plus the
+  STRUCTURAL gate: the kernel's own visit counter must equal
+  ceil(length / block_k) per row, i.e. attention work scales with real
+  token length, not bucket length.
+- `fused_adam_clip_wd_update` (one-pass clip + Adam + decoupled wd) vs
+  the chained `clip_by_global_norm >> adamw` optimizer — and the
+  bit-identity of the off-path (`fused_adamw(wd=0, clip=None)` ==
+  `adam(fused=True)`).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dist_mnist_tpu import optim
+from dist_mnist_tpu.ops import quant
+from dist_mnist_tpu.ops.pallas.flash_attention import (
+    masked_flash_attention,
+    masked_flash_attention_probe,
+    masked_flash_flops,
+    masked_key_blocks,
+)
+from dist_mnist_tpu.ops.pallas.quant_matmul import quant_matmul
+
+
+def _rel_err(got, want):
+    got = jnp.asarray(got, jnp.float32)
+    want = jnp.asarray(want, jnp.float32)
+    return float(jnp.max(jnp.abs(got - want))) / (
+        float(jnp.max(jnp.abs(want))) + 1e-12)
+
+
+# -- fused int8 dequant-matmul ------------------------------------------------
+
+
+def _quantized(rng, d, h, mode="channel"):
+    w = jnp.asarray(rng.standard_normal((d, h)), jnp.float32)
+    if mode == "channel":
+        return quant.quantize(w)
+    scale = jnp.broadcast_to(jnp.max(jnp.abs(w)) / 127.0,
+                             (1, h)).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return quant.QuantizedArray(q, scale, "tensor")
+
+
+@pytest.mark.parametrize("lead,dtype,mode,tol", [
+    ((32,), jnp.float32, "channel", 2e-5),
+    ((4, 17), jnp.float32, "channel", 2e-5),   # odd rows, 3-D activations
+    ((32,), jnp.float32, "tensor", 2e-5),      # per-tensor fallback layout
+    ((32,), jnp.bfloat16, "channel", 2e-2),
+])
+def test_quant_matmul_matches_materialize(lead, dtype, mode, tol):
+    rng = np.random.default_rng(0)
+    d, h = 48, 200  # non-multiples of the 128 tile on purpose
+    w_q = _quantized(rng, d, h, mode)
+    x = jnp.asarray(rng.standard_normal((*lead, d)), dtype)
+    got = quant_matmul(x, w_q.q, w_q.scale)
+    want = x @ quant.dequantize(w_q, x.dtype)
+    assert got.shape == want.shape and got.dtype == x.dtype
+    assert _rel_err(got, want) < tol
+
+
+def test_quant_matmul_scan_stacked_leaves():
+    """The ViT scan layout: [L, D, 3D] kernels with [L, 1, 3D] scales,
+    sliced layer-by-layer by lax.scan before reaching the kernel."""
+    rng = np.random.default_rng(1)
+    layers, d = 3, 32
+    w = jnp.asarray(rng.standard_normal((layers, d, 3 * d)), jnp.float32)
+    qa = quant.quantize(w)
+    assert qa.q.shape == (layers, d, 3 * d)
+    assert qa.scale.shape == (layers, 1, 3 * d)
+    x = jnp.asarray(rng.standard_normal((8, d)), jnp.float32)
+
+    def body(carry, leaf):
+        q, s = leaf
+        return carry, quant_matmul(carry, q, s)
+
+    _, got = jax.lax.scan(body, x, (qa.q, qa.scale))
+    want = jnp.einsum("md,ldh->lmh", x, quant.dequantize(qa))
+    assert _rel_err(got, want) < 2e-5
+
+
+def test_quant_matmul_moe_stacked_leaves_vmap():
+    """The MoE layout: [E, D, H] expert stacks with [E, 1, H] scales,
+    batched over experts by vmap (the moe dense-oracle path)."""
+    rng = np.random.default_rng(2)
+    e, d, h = 4, 32, 64
+    w = jnp.asarray(rng.standard_normal((e, d, h)), jnp.float32)
+    qa = quant.quantize(w)
+    assert qa.scale.shape == (e, 1, h)
+    toks = jnp.asarray(rng.standard_normal((e, 6, d)), jnp.float32)
+    got = jax.vmap(quant_matmul)(toks, qa.q, qa.scale)
+    want = jnp.einsum("emd,edh->emh", toks, quant.dequantize(qa))
+    assert _rel_err(got, want) < 2e-5
+
+
+def test_q_dot_and_q_einsum_dispatch(monkeypatch):
+    """`q_dot`/`q_einsum` route 2-D quantized weights through the Pallas
+    kernel when FUSED_MATMUL forces it, and keep the XLA materialize path
+    otherwise — same numbers either way (that's the whole contract)."""
+    rng = np.random.default_rng(3)
+    w_q = _quantized(rng, 48, 72)
+    x = jnp.asarray(rng.standard_normal((5, 48)), jnp.float32)
+    monkeypatch.setattr(quant, "FUSED_MATMUL", "xla")
+    ref_dot = quant.q_dot(x, w_q)
+    ref_ein = quant.q_einsum("md,dh->mh", x, w_q)
+    monkeypatch.setattr(quant, "FUSED_MATMUL", "pallas")
+    via_dot = quant.q_dot(x, w_q)
+    via_ein = quant.q_einsum("md,dh->mh", x, w_q)
+    assert bool(jnp.array_equal(via_dot,
+                                quant_matmul(x, w_q.q, w_q.scale)))
+    assert bool(jnp.array_equal(via_ein, via_dot))
+    assert _rel_err(via_dot, ref_dot) < 2e-5
+    assert _rel_err(via_ein, ref_ein) < 2e-5
+    # float (non-quantized) weights are a passthrough matmul in any mode
+    w_f = jnp.asarray(rng.standard_normal((48, 72)), jnp.float32)
+    assert bool(jnp.array_equal(quant.q_dot(x, w_f), x @ w_f))
+
+
+def test_q_einsum_non_matmul_spec_stays_on_xla(monkeypatch):
+    """Specs the kernel cannot express (transposed contraction) must fall
+    back to the einsum-on-dequantized path even in forced-pallas mode."""
+    rng = np.random.default_rng(4)
+    w_q = _quantized(rng, 48, 72)
+    x = jnp.asarray(rng.standard_normal((5, 72)), jnp.float32)
+    monkeypatch.setattr(quant, "FUSED_MATMUL", "pallas")
+    got = quant.q_einsum("mh,dh->md", x, w_q)
+    want = jnp.einsum("mh,dh->md", x, quant.dequantize(w_q, x.dtype))
+    assert bool(jnp.array_equal(got, want))
+
+
+# -- masked variable-length flash ---------------------------------------------
+
+
+def _ref_attention(q, k, v, lengths):
+    """The -1e30 pre-softmax einsum (ops/nn.dot_product_attention's mask
+    semantics) on a key-prefix mask."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.arange(k.shape[1])[None, :] < lengths[:, None]
+    logits = jnp.where(mask[:, None, None, :], logits, jnp.float32(-1e30))
+    w = jax.nn.softmax(logits, -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+# every zoo (batch, seq) bucket shape: the engine's batch ladder {8, 16}
+# x the default height ladder 4/8/16 -> 4/8/16 patch tokens + CLS
+ZOO_BUCKETS = [(b, s) for b in (8, 16) for s in (5, 9, 17)]
+
+
+@pytest.mark.parametrize("batch,seq", ZOO_BUCKETS)
+def test_masked_flash_matches_einsum_zoo_buckets(batch, seq):
+    rng = np.random.default_rng(seq * 100 + batch)
+    h, dh = 2, 8
+    q = jnp.asarray(rng.standard_normal((batch, seq, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((batch, seq, h, dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((batch, seq, h, dh)), jnp.float32)
+    lengths = jnp.asarray(rng.integers(1, seq + 1, size=(batch,)),
+                          jnp.int32)
+    got = masked_flash_attention(q, k, v, lengths)
+    want = _ref_attention(q, k, v, lengths)
+    assert _rel_err(got, want) < 2e-5
+
+
+def test_masked_flash_bf16_tolerance():
+    rng = np.random.default_rng(7)
+    b, s, h, dh = 4, 17, 2, 8
+    mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, dh)),
+                             jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    lengths = jnp.asarray([1, 5, 9, 17], jnp.int32)
+    got = masked_flash_attention(q, k, v, lengths)
+    want = _ref_attention(q, k, v, lengths)
+    assert got.dtype == jnp.bfloat16
+    # bf16 has ~3 decimal digits; both paths round differently
+    assert _rel_err(got, want) < 2e-2
+
+
+def test_masked_flash_backward_matches_einsum():
+    rng = np.random.default_rng(8)
+    b, s, h, dh = 2, 300, 2, 8  # two key blocks at block_k=256-pad... 128*3
+    q = jnp.asarray(rng.standard_normal((b, s, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, dh)), jnp.float32)
+    lengths = jnp.asarray([120, 300], jnp.int32)
+
+    def loss(fn):
+        return jax.grad(
+            lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, lengths))),
+            (0, 1, 2))(q, k, v)
+
+    gk = loss(lambda *a: masked_flash_attention(*a, block_k=128))
+    gr = loss(_ref_attention)
+    for a, b_ in zip(gk, gr):
+        assert _rel_err(a, b_) < 2e-5
+    # gradients through masked-out keys are exactly zero (row 0 attends
+    # only its first 120 keys)
+    assert bool(jnp.all(gk[1][0, 120:] == 0.0))
+    assert bool(jnp.all(gk[2][0, 120:] == 0.0))
+
+
+def test_masked_flash_work_scales_with_length_not_bucket():
+    """The structural acceptance gate: the kernel's in-kernel visit
+    counter — incremented inside the same `pl.when` that guards ALL the
+    tile math — equals ceil(length/block_k), strictly below the bucket's
+    block count for short rows; the analytic FLOPs follow the same
+    expression."""
+    rng = np.random.default_rng(9)
+    b, s, h, dh = 4, 512, 2, 8
+    block_k = 128
+    mk = lambda: jnp.asarray(rng.standard_normal((b, s, h, dh)),
+                             jnp.float32)
+    lengths = jnp.asarray([64, 128, 200, 512], jnp.int32)
+    _, visits = masked_flash_attention_probe(mk(), mk(), mk(), lengths,
+                                             block_k=block_k)
+    got_blocks = np.asarray(visits[:, 0, 0], np.int64)
+    want_blocks = np.asarray(masked_key_blocks(lengths, block_k))
+    assert got_blocks.tolist() == want_blocks.tolist() == [1, 1, 2, 4]
+    bucket_blocks = s // block_k
+    assert (got_blocks[:3] < bucket_blocks).all()  # short rows skip work
+    # every head/query-row of a batch row sees the same count
+    assert bool(jnp.all(visits == visits[:, :1, :1]))
+    # reported FLOPs use the same active-block expression -> scale with
+    # real token length, not the bucket ceiling
+    flops = masked_flash_flops(lengths, s, h, dh, block_k)
+    full = 2 * 2 * s * dh * h * s * b
+    assert flops == pytest.approx(full * (1 + 1 + 2 + 4) / (4 * 4))
+
+
+def test_masked_flash_rejects_bad_lengths_shape():
+    x = jnp.zeros((2, 8, 1, 8))
+    with pytest.raises(ValueError, match="lengths"):
+        masked_flash_attention(x, x, x, jnp.zeros((3,), jnp.int32))
+
+
+# -- one-pass fused clip + Adam + decoupled wd --------------------------------
+
+
+def _tree(rng):
+    return {"w": jnp.asarray(rng.standard_normal((130, 257)), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((7,)), jnp.float32)}
+
+
+def test_fused_adamw_matches_chained_clip_adamw():
+    rng = np.random.default_rng(10)
+    params = _tree(rng)
+    grads = jax.tree.map(
+        lambda p: 3.0 * jnp.asarray(rng.standard_normal(p.shape),
+                                    jnp.float32), params)
+    ref = optim.chain(optim.clip_by_global_norm(0.5),
+                      optim.adamw(1e-3, weight_decay=0.01))
+    fused = optim.fused_adamw(1e-3, weight_decay=0.01, clip_norm=0.5)
+    s_r, s_f = ref.init(params), fused.init(params)
+    p_r, p_f = params, params
+    for _ in range(3):
+        u_r, s_r = ref.update(grads, s_r, p_r)
+        u_f, s_f = fused.update(grads, s_f, p_f)
+        p_r = optim.apply_updates(p_r, u_r)
+        p_f = optim.apply_updates(p_f, u_f)
+    for a, b in zip(jax.tree.leaves(p_r), jax.tree.leaves(p_f)):
+        assert _rel_err(a, b) < 1e-6
+    # slot trees stay plain containers (checkpoint-manager contract)
+    assert set(s_f) == {"m", "v", "count"}
+
+
+def test_fused_adamw_off_path_bit_identical():
+    """wd=0 + no clip routes to the EXACT original fused kernel: the
+    one-pass variant must not perturb the plain-Adam path by even 1 ulp."""
+    rng = np.random.default_rng(11)
+    params = _tree(rng)
+    grads = jax.tree.map(
+        lambda p: jnp.asarray(rng.standard_normal(p.shape), jnp.float32),
+        params)
+    a = optim.adam(1e-3, fused=True)
+    f = optim.fused_adamw(1e-3, weight_decay=0.0, clip_norm=None)
+    u_a, s_a = a.update(grads, a.init(params), params)
+    u_f, s_f = f.update(grads, f.init(params), params)
+    for ta, tf in ((u_a, u_f), (s_a["m"], s_f["m"]), (s_a["v"], s_f["v"])):
+        for x, y in zip(jax.tree.leaves(ta), jax.tree.leaves(tf)):
+            assert bool(jnp.array_equal(x, y))
+
+
+def test_fused_adamw_wd_only_matches_adamw():
+    """clip_norm=None + wd>0 exercises the clip_scale=1 kernel path."""
+    rng = np.random.default_rng(12)
+    params = _tree(rng)
+    grads = jax.tree.map(
+        lambda p: jnp.asarray(rng.standard_normal(p.shape), jnp.float32),
+        params)
+    ref = optim.adamw(1e-3, weight_decay=0.02)
+    fused = optim.fused_adamw(1e-3, weight_decay=0.02)
+    u_r, _ = ref.update(grads, ref.init(params), params)
+    u_f, _ = fused.update(grads, fused.init(params), params)
+    for a, b in zip(jax.tree.leaves(u_r), jax.tree.leaves(u_f)):
+        assert _rel_err(a, b) < 1e-6
+
+
+# -- model wiring -------------------------------------------------------------
+
+
+def test_vit_masked_flash_matches_masked_xla():
+    """The serve path: a maskable ViT with attention_impl='flash' runs
+    the variable-length kernel and agrees with the xla einsum engine on
+    the same sub-native masked batch."""
+    from dist_mnist_tpu.models.registry import get_model
+    from dist_mnist_tpu.serve.zoo import supports_mask
+
+    common = dict(depth=1, dim=16, heads=2, patch=4, pool="mean",
+                  compute_dtype=jnp.float32)
+    vx = get_model("vit_tiny", attention_impl="xla", **common)
+    vf = get_model("vit_tiny", attention_impl="flash", **common)
+    assert supports_mask(vf)
+    x = jnp.asarray(np.random.default_rng(13).standard_normal(
+        (2, 16, 16, 3)), jnp.float32)
+    p, s = vx.init(jax.random.PRNGKey(0), x)
+    n_tok = (16 // 4) * (16 // 4)
+    mask = np.ones((2, n_tok), bool)
+    mask[1, 4:] = False  # sample 1: one real patch row
+    ox, _ = vx.apply(p, s, x, mask=jnp.asarray(mask))
+    of, _ = vf.apply(p, s, x, mask=jnp.asarray(mask))
+    assert _rel_err(of, ox) < 2e-5
+    assert bool(jnp.all(jnp.argmax(ox, -1) == jnp.argmax(of, -1)))
+
+
+def test_causal_lm_flash_decode_matches_xla():
+    """attention_impl='flash' decode (lengths = pos + 1 against the
+    cache) tracks the bit-exact xla path within fp tolerance and agrees
+    on every sampled token."""
+    from dist_mnist_tpu.models.causal_lm import CausalLMTiny
+
+    mx = CausalLMTiny()
+    mf = CausalLMTiny(attention_impl="flash")
+    params, _ = mx.init(jax.random.PRNGKey(1))
+    cx, cf = mx.init_cache(4), mf.init_cache(4)
+    toks = jnp.asarray(np.random.default_rng(14).integers(
+        0, 256, size=(4, 16)))
+    lengths = jnp.asarray([16, 9, 4, 12])
+    last_x, cx = mx.prefill(params, cx, toks, jnp.arange(4), lengths)
+    last_f, cf = mf.prefill(params, cf, toks, jnp.arange(4), lengths)
+    # prefill keeps the xla path -> bit-identical
+    assert bool(jnp.array_equal(last_x, last_f))
+    pos, tok = lengths, jnp.argmax(last_x, -1)
+    for _ in range(4):
+        lx, cx = mx.decode_step(params, cx, tok, pos)
+        lf, cf = mf.decode_step(params, cf, tok, pos)
+        assert _rel_err(lf, lx) < 1e-5
+        assert bool(jnp.all(jnp.argmax(lx, -1) == jnp.argmax(lf, -1)))
+        tok, pos = jnp.argmax(lx, -1), pos + 1
+
+
+def test_causal_lm_rejects_unknown_attention_impl():
+    from dist_mnist_tpu.models.causal_lm import CausalLMTiny
+
+    with pytest.raises(ValueError, match="attention_impl"):
+        CausalLMTiny(attention_impl="ring").init(jax.random.PRNGKey(0))

@@ -91,7 +91,10 @@ def test_seq_grid_validation_and_parse():
 
 def test_supports_mask_gates_kernel_attention():
     assert supports_mask(_tiny_vit())
-    assert not supports_mask(_tiny_vit(attention_impl="flash"))
+    # flash is maskable since the variable-length kernel landed: zoo
+    # prefix masks become per-row lengths (ops/pallas/flash_attention)
+    assert supports_mask(_tiny_vit(attention_impl="flash"))
+    assert not supports_mask(_tiny_vit(attention_impl="ring"))
     assert not supports_mask(get_model("mlp"))
 
 
