@@ -2572,6 +2572,110 @@ def bench_kernels() -> int:
     return 0
 
 
+def bench_tune() -> int:
+    """Closed-loop autotune mode (`--tune`): run the seeded two-knob
+    successive-halving search (overlap bucket granularity + the serve
+    (batch, seq-bucket) grid) through dist_mnist_tpu/tune's deterministic
+    objectives, assert each winner STRICTLY beats the stock default on
+    the same seeded stream, and persist the winners — evidence embedded —
+    to a TunedConfigStore keyed to this exact geometry, so a later
+    `--tuned=auto` train/serve run picks them up.
+
+    Headline `tuned_vs_default_ratio` = geometric mean of the per-knob
+    winner/default objective ratios (< 1.0 ⇔ the tuner found strictly
+    better settings than the hand-picked defaults). The objectives are
+    structural cost models fed by the REAL machinery (overlap planner
+    bucket stats, zoo SeqGrid padding arithmetic over a seeded varlen
+    stream) rather than wall clock, so the number is deterministic on
+    the CPU mesh and PERF_ANCHOR.json can pin it — the same reasoning
+    as `kernels_parity_max_rel_err`."""
+    import math
+
+    import jax
+
+    from dist_mnist_tpu.cluster.mesh import make_mesh
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.tune.objectives import (
+        TuneObjectiveUnavailable,
+        overlap_cost_objective,
+        serve_grid_objective,
+    )
+    from dist_mnist_tpu.tune.search import successive_halving
+    from dist_mnist_tpu.tune.spec import KNOBS
+    from dist_mnist_tpu.tune.store import (
+        TunedConfigStore,
+        make_entry,
+        tuning_key,
+    )
+
+    metric = "tuned_vs_default_ratio"
+    cfg = get_config("mlp_mnist")
+    mesh = make_mesh(cfg.mesh)
+
+    results, skipped, knob_blocks = [], {}, {}
+    for name, build in (
+        ("overlap_bucket_mb", lambda: overlap_cost_objective(mesh)),
+        ("serve_grid", serve_grid_objective),
+    ):
+        try:
+            objective = build()
+        except TuneObjectiveUnavailable as e:
+            skipped[name] = str(e)  # e.g. single-chip: nothing to gather
+            continue
+        res = successive_halving(KNOBS[name], objective, seed=0,
+                                 base_budget=32)
+        # the whole point of the search: a winner that is not strictly
+        # better than the default on the SAME seeded stream is a bug in
+        # the ladder or the objective, not a result
+        if not res.strictly_beats_default:
+            raise AssertionError(
+                f"tuned {name}={res.winner!r} does not strictly beat "
+                f"default {res.spec.default!r} on the same stream "
+                f"({res.spec.metric}: {res.winner_score:.6f} vs "
+                f"{res.default_score:.6f})")
+        results.append(res)
+        knob_blocks[name] = {
+            "winner": res.winner,
+            "default": res.spec.default,
+            res.spec.metric: round(res.winner_score, 6),
+            f"default_{res.spec.metric}": round(res.default_score, 6),
+            "vs_default_ratio": round(res.vs_default_ratio, 6),
+            "rounds": res.rounds,
+            "trials": len(res.trials),
+            "final_budget": res.final_budget,
+        }
+    if not results:
+        raise TuneObjectiveUnavailable(
+            f"no knob was searchable on this geometry: {skipped}")
+
+    ratio = math.exp(
+        sum(math.log(r.vs_default_ratio) for r in results) / len(results))
+
+    store_dir = os.environ.get("DIST_MNIST_TPU_TUNED_DIR",
+                               "/tmp/dist_mnist_tpu_tuned")
+    store = TunedConfigStore(store_dir)
+    key = tuning_key(cfg, mesh)
+    store.save(key, make_entry(cfg, mesh, results))
+
+    emit({
+        "metric": metric,
+        "value": round(ratio, 6),
+        "unit": "tuned/default ratio",  # < 1.0 ⇔ tuned strictly wins
+        "vs_baseline": 0.0,  # attribution metric: no published reference
+        "extra": {
+            "chips": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind,
+            "seed": 0,
+            "knobs": knob_blocks,
+            "skipped": skipped,
+            "store": store_dir,
+            "key": key,
+            **_anchor_fields(metric, ratio),
+        },
+    })
+    return 0
+
+
 def main() -> int:
     import jax
 
@@ -2708,6 +2812,16 @@ if __name__ == "__main__":
                          "analytic FLOPs/HBM bytes, achieved rates, "
                          "achieved-vs-peak fractions on TPU "
                          "(kernels_parity_max_rel_err)")
+    ap.add_argument("--tune", action="store_true", dest="tune_mode",
+                    help="closed-loop autotune mode: seeded "
+                         "successive-halving search over the overlap "
+                         "bucket size and the serve (batch, seq-bucket) "
+                         "grid via dist_mnist_tpu/tune's deterministic "
+                         "objectives; asserts every winner strictly "
+                         "beats the stock default on the same stream and "
+                         "persists the winners + evidence to a "
+                         "TunedConfigStore for --tuned=auto runs "
+                         "(tuned_vs_default_ratio)")
     ap.add_argument("--input", action="store_true", dest="input_mode",
                     help="input-stall attribution mode: time sync-feed vs "
                          "device-prefetched feed on the same model/stream "
@@ -2779,6 +2893,7 @@ if __name__ == "__main__":
               else "quant_p99_ms" if args.serve and args.quant
               else "serve_p99_latency_ms" if args.serve
               else "kernels_parity_max_rel_err" if args.kernels_mode
+              else "tuned_vs_default_ratio" if args.tune_mode
               else "input_stall_ms_per_step" if args.input_mode
               else "fsdp_per_device_state_bytes" if args.memory_mode
               else "comm_exposed_ms_per_step" if args.overlap_mode
@@ -2816,6 +2931,7 @@ if __name__ == "__main__":
                  else bench_serve(args.requests, args.concurrency)
                  if args.serve
                  else bench_kernels() if args.kernels_mode
+                 else bench_tune() if args.tune_mode
                  else bench_input(args.steps, depth=args.prefetch_depth)
                  if args.input_mode
                  else bench_memory(args.config) if args.memory_mode

@@ -4,7 +4,9 @@ PR 7 retrofitted the overlap knobs into `compile_cache_key_fields` by
 hand after a stale serial executable could have served an overlapped
 run. This rule makes the invariant structural: diff the fields of the
 `Config` dataclass (configs.py) against the ``cfg.<field>`` reads inside
-`cli/train.py compile_cache_key_fields`. A field that is neither read by
+`compilecache/key_fields.py compile_cache_key_fields` (moved out of
+cli/train.py so serve/tune processes can import it without re-running
+the train CLI's flag definitions). A field that is neither read by
 the key builder nor on the explicit runtime-only allowlist is a finding
 — new knobs default to "invalidates the cache" until someone argues
 otherwise IN the allowlist, with a reason.
@@ -18,6 +20,17 @@ old constant — the numbers drift, nothing crashes.
 A second, narrower check pins the serve path: `serve/engine.py` must
 mention "quant" in both its in-memory and disk key builders (PR 13's
 invariant — an int8 program can never satisfy a float key).
+
+A third check closes the same loop over the autotuner's knob catalog
+(`tune/spec.py`): a `TunableSpec` declared ``compile_relevant=True``
+must have every stored knob name appear in `compile_cache_key_fields`
+(as a ``cfg.<name>`` read OR a dict-literal key — `scan_chunk` is keyed
+as a builder parameter, not a Config field), so a tuner-applied value
+always forces an executable-store miss; one declared
+``compile_relevant=False`` must be allowlisted in TUNER_RUNTIME_ONLY
+with a reason. Tuner knobs are NOT all Config fields (prefetch_depth
+and the serve grid are CLI-flag surfaces), hence the separate allowlist:
+folding them into RUNTIME_ONLY would trip its staleness check.
 """
 
 from __future__ import annotations
@@ -27,7 +40,7 @@ import ast
 from dist_mnist_tpu.analysis.core import Context, Finding, Rule
 
 CONFIGS_PATH = "dist_mnist_tpu/configs.py"
-KEY_BUILDER_PATH = "dist_mnist_tpu/cli/train.py"
+KEY_BUILDER_PATH = "dist_mnist_tpu/compilecache/key_fields.py"
 KEY_BUILDER_FN = "compile_cache_key_fields"
 ENGINE_PATH = "dist_mnist_tpu/serve/engine.py"
 
@@ -45,6 +58,22 @@ RUNTIME_ONLY: dict[str, str] = {
     "ladder_devices": "bench-ladder sizing metadata; never traced",
     "mesh": "the LIVE mesh shape is keyed from the constructed Mesh "
             "argument instead (a MeshSpec of -1s is unresolved)",
+}
+
+TUNE_SPEC_PATH = "dist_mnist_tpu/tune/spec.py"
+
+#: runtime-only TUNER knobs (tune/spec.py compile_relevant=False):
+#: applied by --tuned=auto without invalidating any compiled step.
+#: Same contract as RUNTIME_ONLY — every entry argues its why — but a
+#: separate dict because these are knob names, not Config fields, and
+#: RUNTIME_ONLY's staleness check diffs against the Config dataclass.
+TUNER_RUNTIME_ONLY: dict[str, str] = {
+    "prefetch_depth": "host-side prefetch ring depth (data/prefetch.py);"
+                      " the traced program is identical at every depth",
+    "serve_max_batch": "shapes the serve zoo's (batch, seq) grid; every"
+                       " grid cell compiles under its own zoo executable"
+                       " key (serve/zoo.py), never the train-step key",
+    "serve_seq_buckets": "same grid: per-bucket zoo keys absorb it",
 }
 
 
@@ -81,6 +110,55 @@ def _keyed_fields(ctx: Context) -> set[str] | None:
     return None
 
 
+def _key_literal_keys(ctx: Context) -> set[str]:
+    """Dict-literal string keys inside the key builder — the payload
+    entries that are builder parameters rather than ``cfg.`` reads
+    (scan_chunk, input_pipeline, dtype...). Kept separate from
+    `_keyed_fields` so the Config-field check's semantics are untouched:
+    a Config field must be READ, not merely share a name with a key."""
+    sf = ctx.source(KEY_BUILDER_PATH)
+    if sf is None or sf.tree is None:
+        return set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == KEY_BUILDER_FN:
+            return {
+                k.value
+                for sub in ast.walk(node) if isinstance(sub, ast.Dict)
+                for k in sub.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+    return set()
+
+
+def _tunable_specs(ctx: Context) -> list[tuple[int, str, tuple, bool]]:
+    """(lineno, spec name, stored knob names, compile_relevant) for every
+    `TunableSpec(...)` registration in the tuner's knob catalog."""
+    sf = ctx.source(TUNE_SPEC_PATH)
+    if sf is None or sf.tree is None:
+        return []
+    out = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "TunableSpec"):
+            continue
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        name = kw.get("name")
+        if not (isinstance(name, ast.Constant)
+                and isinstance(name.value, str)):
+            continue
+        fields = kw.get("fields")
+        knob_names = tuple(
+            e.value for e in fields.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ) if isinstance(fields, ast.Tuple) else (name.value,)
+        relevant = kw.get("compile_relevant")
+        out.append((node.lineno, name.value, knob_names,
+                    bool(isinstance(relevant, ast.Constant)
+                         and relevant.value)))
+    return out
+
+
 class CacheKeyRule(Rule):
     rule_id = "cache-key"
     doc = ("Config dataclass fields missing from compile_cache_key_fields "
@@ -114,6 +192,35 @@ class CacheKeyRule(Rule):
                     self.rule_id, CONFIGS_PATH, 1,
                     f"RUNTIME_ONLY allowlists {field!r}, which is no "
                     f"longer a Config field — drop the entry"))
+        # tuner knob catalog: compile_relevant knobs must be keyed, the
+        # rest must carry a reason in TUNER_RUNTIME_ONLY
+        specs = _tunable_specs(ctx)
+        literal_keys = _key_literal_keys(ctx)
+        declared: set[str] = set()
+        for lineno, spec_name, knob_names, relevant in specs:
+            declared.update(knob_names)
+            for knob in knob_names:
+                if relevant and not (knob in keyed or knob in literal_keys):
+                    out.append(Finding(
+                        self.rule_id, TUNE_SPEC_PATH, lineno,
+                        f"tunable {spec_name!r} declares {knob!r} "
+                        f"compile-relevant but {KEY_BUILDER_FN}() neither "
+                        f"reads cfg.{knob} nor keys a {knob!r} payload "
+                        f"entry — a --tuned=auto run would reuse an "
+                        f"executable compiled under the default"))
+                elif not relevant and knob not in TUNER_RUNTIME_ONLY:
+                    out.append(Finding(
+                        self.rule_id, TUNE_SPEC_PATH, lineno,
+                        f"tunable {spec_name!r} declares {knob!r} "
+                        f"runtime-only but TUNER_RUNTIME_ONLY has no "
+                        f"entry arguing why — add one "
+                        f"(analysis/rules/cache_key.py)"))
+        for knob in sorted(TUNER_RUNTIME_ONLY):
+            if specs and knob not in declared:
+                out.append(Finding(
+                    self.rule_id, TUNE_SPEC_PATH, 1,
+                    f"TUNER_RUNTIME_ONLY allowlists {knob!r}, which no "
+                    f"TunableSpec declares any more — drop the entry"))
         # serve path: quant must stay folded into both engine key tiers
         engine = ctx.read_text(ENGINE_PATH)
         if engine is not None and engine.count("quant") < 2:

@@ -48,6 +48,10 @@ HOT_PATH_TARGETS = (
     # the Pallas kernel dispatch wrappers run per serve request / train
     # step — a host sync there stalls the whole pipeline
     "dist_mnist_tpu/ops/pallas/*.py",
+    # the tuner's objectives run bench legs in a scoring loop: an
+    # unsuppressed sync there multiplies across every trial of every
+    # halving round (the score handoff itself is suppressed, reasoned)
+    "dist_mnist_tpu/tune/*.py",
 )
 
 

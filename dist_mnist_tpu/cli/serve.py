@@ -125,6 +125,16 @@ flags.DEFINE_string("journal", None,
                     "defaults to $DIST_MNIST_TPU_JOURNAL, else "
                     "<logdir>/events.jsonl when --logdir is set")
 # -- fleet-replica mode -------------------------------------------------------
+flags.DEFINE_enum("tuned", "auto", ["auto", "off", "require"],
+                  "persisted-autotuner serve knobs (dist_mnist_tpu/tune): "
+                  "auto = apply the stored serve grid (max_batch / "
+                  "seq_buckets winners) for this exact geometry when an "
+                  "entry exists, defaults on a miss; require = fail fast "
+                  "on a miss; off = never consult the store. Explicit "
+                  "--max_batch/--seq_buckets always win. docs/TUNING.md")
+flags.DEFINE_string("tuned_dir", None,
+                    "TunedConfigStore directory; defaults to "
+                    "$DIST_MNIST_TPU_TUNED_DIR")
 flags.DEFINE_boolean("serve_forever", False,
                      "run as a fleet replica until SIGTERM/SIGINT: the "
                      "metrics exporter serves POST /predict and /swap next "
@@ -315,6 +325,27 @@ def main(argv):
         print(json.dumps(summary, indent=2, sort_keys=True))
         return
 
+    max_batch, seq_buckets = FLAGS.max_batch, FLAGS.seq_buckets
+    if FLAGS.tuned != "off":
+        # tuned serve grid for this geometry (dist_mnist_tpu/tune):
+        # applied before the engine/server are built so the winners
+        # shape the zoo grid and the batcher ceiling; explicitly-set
+        # flags stay pinned. The journal is installed above, so the
+        # application lands as tuning/applied with its evidence.
+        from dist_mnist_tpu.tune import apply_tuned
+
+        protect = tuple(
+            name for name, pinned in (
+                ("serve_max_batch", FLAGS["max_batch"].present),
+                ("serve_seq_buckets", FLAGS["seq_buckets"].present),
+            ) if pinned)
+        _, tuned_knobs = apply_tuned(
+            cfg, mesh, mode=FLAGS.tuned, store_dir=FLAGS.tuned_dir,
+            protect=protect, subsystem="serve")
+        if "serve_max_batch" in tuned_knobs:
+            max_batch = int(tuned_knobs["serve_max_batch"])
+        if "serve_seq_buckets" in tuned_knobs:
+            seq_buckets = str(tuned_knobs["serve_seq_buckets"])
     bundle = load_for_serving(
         cfg, mesh, checkpoint_dir=FLAGS.checkpoint_dir, step=FLAGS.step,
         sharding_rules=FLAGS.serve_rules, quant=FLAGS.quant or None,
@@ -333,8 +364,8 @@ def main(argv):
         store = ExecutableStore(cache_root / "exe")
     engine = build_zoo_engine(
         bundle, mesh, model_name=cfg.model,
-        max_bucket=max(FLAGS.max_batch, 1),
-        seq_buckets=FLAGS.seq_buckets or None,
+        max_bucket=max(max_batch, 1),
+        seq_buckets=seq_buckets or None,
         moe_capacity_factor=FLAGS.moe_capacity_factor or None,
         memory_budget_mb=FLAGS.serve_memory_budget_mb or None,
         store=store,
@@ -352,7 +383,7 @@ def main(argv):
     server = InferenceServer(
         engine,
         ServeConfig(
-            max_batch=FLAGS.max_batch,
+            max_batch=max_batch,
             max_wait_ms=FLAGS.max_wait_ms,
             queue_depth=FLAGS.queue_depth,
             default_deadline_ms=FLAGS.deadline_ms or None,

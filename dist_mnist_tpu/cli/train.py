@@ -196,6 +196,20 @@ flags.DEFINE_enum("snapshot_policy", "block", ["block", "drop_oldest"],
                   "what a save does when the snapshot window is full: block "
                   "(attributed save_stall) or drop the oldest queued "
                   "snapshot")
+flags.DEFINE_enum("tuned", "auto", ["auto", "off", "require"],
+                  "persisted-autotuner knobs (dist_mnist_tpu/tune): auto = "
+                  "apply the TunedConfigStore winners for this exact "
+                  "model/mesh/backend/jax-version geometry when an entry "
+                  "exists (journaled as tuning/applied with the measured "
+                  "evidence), fall back to defaults on a miss; require = "
+                  "fail fast on a miss; off = never consult the store "
+                  "(bit-identical to pre-tuner behavior). Explicit knob "
+                  "flags (--overlap_bucket_mb etc.) always win over stored "
+                  "values. See docs/TUNING.md")
+flags.DEFINE_string("tuned_dir", None,
+                    "TunedConfigStore directory (cli/tune.py writes it); "
+                    "defaults to $DIST_MNIST_TPU_TUNED_DIR, and with "
+                    "neither set --tuned=auto is a no-op")
 flags.DEFINE_string("peer_dir", None,
                     "peer-ring shard redundancy root (checkpoint/peer.py): "
                     "each host serializes its shards to its own dir AND its "
@@ -241,53 +255,14 @@ def build_optimizer(cfg):
     return opt
 
 
-def compile_cache_key_fields(cfg, mesh, *, scan_chunk=0,
-                             input_pipeline="python", quant="none"):
-    """Everything that changes the compiled step program, as a flat dict —
-    the ExecutableStore key is `cache_key({"kind": ..., **fields})`. The
-    overlap knobs are in here so a cached serial executable can never be
-    served to an overlapped run (or vice versa): the two lower to different
-    HLO even though they are value-identical. `quant` likewise: an int8
-    weight-only program takes (int8, scale) weight arguments, so it can
-    never satisfy a float key (or vice versa); "none" keeps the field OUT
-    of the payload entirely — every pre-quant disk key stays warm."""
-    fields = {
-        "config": cfg.name,
-        "model": cfg.model,
-        "model_kwargs": cfg.model_kwargs,
-        "batch_size": cfg.batch_size,
-        "optimizer": cfg.optimizer,
-        "loss": cfg.loss,
-        "remat": cfg.remat,
-        "remat_policy": cfg.remat_policy,
-        "augment": cfg.augment,
-        "mesh": tuple(sorted(mesh.shape.items())),
-        "sharding": cfg.sharding_rules,
-        "overlap": cfg.overlap,
-        "overlap_bucket_mb": cfg.overlap_bucket_mb,
-        "overlap_chunk": cfg.overlap_chunk,
-        "dtype": "float32",
-        "donate": True,
-        "scan_chunk": scan_chunk,
-        "input_pipeline": input_pipeline,
-        "prng": cfg.prng_impl,
-        # the optimizer chain closes over these as Python scalars, so they
-        # are constant-folded into the jitted update: a cached executable
-        # from a different schedule/regularization would train wrong —
-        # silently. Likewise dataset (input shapes) and
-        # replicas_to_aggregate (accumulation loop structure).
-        "dataset": cfg.dataset,
-        "train_steps": cfg.train_steps,
-        "learning_rate": cfg.learning_rate,
-        "lr_schedule": cfg.lr_schedule,
-        "warmup_steps": cfg.warmup_steps,
-        "replicas_to_aggregate": cfg.replicas_to_aggregate,
-        "grad_clip_norm": cfg.grad_clip_norm,
-        "weight_decay": cfg.weight_decay,
-    }
-    if quant and quant != "none":
-        fields["quant"] = quant
-    return fields
+# compile_cache_key_fields moved to compilecache/key_fields.py (import-pure:
+# serve and the tuner hash the same geometry fields, and importing this
+# module from another absl CLI would re-run the flags.DEFINE_* block).
+# Re-exported here so every existing `from ...cli.train import
+# compile_cache_key_fields` keeps working.
+from dist_mnist_tpu.compilecache.key_fields import (  # noqa: E402
+    compile_cache_key_fields,
+)
 
 
 def run_config(cfg, **kwargs):
@@ -332,6 +307,9 @@ def _run_config(
     snapshot_window: int = 1,
     snapshot_policy: str = "block",
     peer_dir: str | None = None,
+    tuned: str = "auto",
+    tuned_dir: str | None = None,
+    tuned_protect=(),
 ):
     """Implementation behind `run_config` (the public wrapper adds the
     PRNG-impl scope — call THAT, not this).
@@ -412,6 +390,7 @@ def _run_config(
             snapshot_window=snapshot_window,
             snapshot_policy=snapshot_policy,
             peer_dir=peer_dir,
+            tuned=tuned, tuned_dir=tuned_dir, tuned_protect=tuned_protect,
         )
         import jax as _jax
 
@@ -478,6 +457,9 @@ def _run_train(
     snapshot_window: int = 1,
     snapshot_policy: str = "block",
     peer_dir: str | None = None,
+    tuned: str = "auto",
+    tuned_dir: str | None = None,
+    tuned_protect=(),
 ):
     """The training run itself (see `_run_config`, which wraps it in the
     observability scope and owns the exporter/journal lifecycles)."""
@@ -566,6 +548,25 @@ def _run_train(
                     cfg.elastic_batch_policy, cfg.batch_size,
                     cfg.learning_rate,
                 )
+        if tuned != "off":
+            # persisted-autotuner lookup (dist_mnist_tpu/tune): keyed over
+            # the FINAL geometry (post-elastic-policy, live mesh), before
+            # anything expensive — a --tuned=require miss fails here, and
+            # an applied overlap knob lands before the key fields and the
+            # overlap schedule below consume cfg. --tuned=off never
+            # reaches this import: bit-identical to the pre-tuner path.
+            from dist_mnist_tpu.tune import apply_tuned
+
+            cfg, _tuned_runtime = apply_tuned(
+                cfg, mesh, mode=tuned, store_dir=tuned_dir,
+                protect=tuple(tuned_protect), subsystem="train")
+            if overlap_cfg is not None:
+                from dist_mnist_tpu.parallel.overlap import OverlapConfig
+
+                overlap_cfg = OverlapConfig(bucket_mb=cfg.overlap_bucket_mb,
+                                            chunk=cfg.overlap_chunk)
+            if "prefetch_depth" in _tuned_runtime:
+                prefetch_depth = int(_tuned_runtime["prefetch_depth"])
         dataset = load_dataset(cfg.dataset, data_dir, seed=cfg.seed)
         model = get_model(cfg.model, **cfg.model_kwargs)
         optimizer = build_optimizer(cfg)
@@ -987,6 +988,16 @@ def main(argv):
             snapshot_window=FLAGS.snapshot_window,
             snapshot_policy=FLAGS.snapshot_policy,
             peer_dir=FLAGS.peer_dir,
+            tuned=FLAGS.tuned,
+            tuned_dir=FLAGS.tuned_dir,
+            # explicitly-flagged knobs outrank stored winners: the
+            # operator pinned them, the tuner must not clobber them
+            tuned_protect=tuple(
+                name for name, pinned in (
+                    ("overlap_bucket_mb", FLAGS.overlap_bucket_mb is not None),
+                    ("overlap_chunk", FLAGS.overlap_chunk is not None),
+                    ("prefetch_depth", FLAGS["prefetch_depth"].present),
+                ) if pinned),
         )
     finally:
         uninstall()

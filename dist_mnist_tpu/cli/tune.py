@@ -1,0 +1,16 @@
+"""`cli/tune.py` — the offline knob search, as a cli/ entrypoint.
+
+Thin delegation to `dist_mnist_tpu.tune.cli` (also reachable as
+`python -m dist_mnist_tpu.tune`); both surfaces exist so the tuner sits
+next to cli/train.py and cli/serve.py, whose `--tuned=auto` consumes
+the store this writes. Usage and flags: tune/cli.py.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from dist_mnist_tpu.tune.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""`compile_cache_key_fields` — everything that changes the compiled
+step program, as a flat dict. Lives here (not in cli/train.py, its
+historical home) because non-train processes need the key builder too:
+the tuner's geometry key (`tune/store.tuned_key_fields`) hashes these
+fields from `cli/serve.py` and `python -m dist_mnist_tpu.tune`, and
+importing cli/train.py from another absl CLI re-executes its
+`flags.DEFINE_*` block — a DuplicateFlagError under `python -m`, a flag
+collision (`--config` et al.) from serve. This module is import-pure:
+no flags, no jax. cli/train.py re-exports the name, so
+`from dist_mnist_tpu.cli.train import compile_cache_key_fields`
+keeps working everywhere train is already imported.
+"""
+
+from __future__ import annotations
+
+__all__ = ["compile_cache_key_fields"]
+
+
+def compile_cache_key_fields(cfg, mesh, *, scan_chunk=0,
+                             input_pipeline="python", quant="none"):
+    """Everything that changes the compiled step program, as a flat dict —
+    the ExecutableStore key is `cache_key({"kind": ..., **fields})`. The
+    overlap knobs are in here so a cached serial executable can never be
+    served to an overlapped run (or vice versa): the two lower to different
+    HLO even though they are value-identical. `quant` likewise: an int8
+    weight-only program takes (int8, scale) weight arguments, so it can
+    never satisfy a float key (or vice versa); "none" keeps the field OUT
+    of the payload entirely — every pre-quant disk key stays warm."""
+    fields = {
+        "config": cfg.name,
+        "model": cfg.model,
+        "model_kwargs": cfg.model_kwargs,
+        "batch_size": cfg.batch_size,
+        "optimizer": cfg.optimizer,
+        "loss": cfg.loss,
+        "remat": cfg.remat,
+        "remat_policy": cfg.remat_policy,
+        "augment": cfg.augment,
+        "mesh": tuple(sorted(mesh.shape.items())),
+        "sharding": cfg.sharding_rules,
+        "overlap": cfg.overlap,
+        "overlap_bucket_mb": cfg.overlap_bucket_mb,
+        "overlap_chunk": cfg.overlap_chunk,
+        "dtype": "float32",
+        "donate": True,
+        "scan_chunk": scan_chunk,
+        "input_pipeline": input_pipeline,
+        "prng": cfg.prng_impl,
+        # the optimizer chain closes over these as Python scalars, so they
+        # are constant-folded into the jitted update: a cached executable
+        # from a different schedule/regularization would train wrong —
+        # silently. Likewise dataset (input shapes) and
+        # replicas_to_aggregate (accumulation loop structure).
+        "dataset": cfg.dataset,
+        "train_steps": cfg.train_steps,
+        "learning_rate": cfg.learning_rate,
+        "lr_schedule": cfg.lr_schedule,
+        "warmup_steps": cfg.warmup_steps,
+        "replicas_to_aggregate": cfg.replicas_to_aggregate,
+        "grad_clip_norm": cfg.grad_clip_norm,
+        "weight_decay": cfg.weight_decay,
+    }
+    if quant and quant != "none":
+        fields["quant"] = quant
+    return fields

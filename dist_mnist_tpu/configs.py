@@ -44,7 +44,8 @@ class Config:
     # identical to the serial path (bit-exact on the CPU mesh).
     overlap_bucket_mb: float = 4.0  # bucket granularity: smaller buckets =
     # more chunks in flight (better overlap, more launches); larger = fewer,
-    # bigger transfers
+    # bigger transfers. Registered tunable (tune/spec.py): --tuned=auto
+    # applies the per-geometry stored winner unless this is set explicitly
     overlap_chunk: str = "all_gather"  # "all_gather" (one collective per
     # leaf) | "ring" (ppermute double-buffering, collective_matmul-style)
     grad_clip_norm: float | None = None

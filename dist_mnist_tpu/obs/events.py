@@ -18,9 +18,11 @@ under ``PIPE_BUF`` bytes are atomic, so the supervisor and its child
 processes share one file without interleaving torn lines.
 
 The module-level *current journal* lets deep subsystems (checkpoint
-manager, fault injectors, compile cache) emit events without threading
-a journal handle through every constructor: ``events.emit(...)`` is a
-no-op unless someone installed a journal via ``set_journal``.
+manager, fault injectors, compile cache, the autotuner's ``tuning/*``
+family — search trials, winners, applied knobs, stale keys) emit events
+without threading a journal handle through every constructor:
+``events.emit(...)`` is a no-op unless someone installed a journal via
+``set_journal``.
 
 Stdlib-only on purpose: importable from the supervisor and from any
 process before jax/numpy are up.

@@ -68,6 +68,12 @@ class SeqGrid:
     multiples of `patch`, with the init-time native height always last:
     the native bucket serves the maskless bit-parity program, every
     sub-native bucket serves the masked variant.
+
+    The grid's shape (batch ceiling × these buckets) is a registered
+    tunable (tune/spec.py `serve_grid`): the tuner scores candidate
+    grids by replaying a seeded variable-height stream through this
+    class's bucketing arithmetic, and `cli/serve.py --tuned=auto`
+    applies the stored per-geometry winner.
     """
 
     native_height: int

@@ -1,0 +1,40 @@
+"""Persistent autotuner: the bench loop closed into a knob-search engine.
+
+- tune/spec.py — `TunableSpec` + the registered knob catalog (KNOBS)
+- tune/search.py — seeded successive halving over a spec's ladder
+- tune/objectives.py — bench-leg-backed objective functions
+- tune/store.py — `TunedConfigStore`: winners + embedded evidence,
+  keyed over the executable-cache geometry fields; `apply_tuned` is the
+  `--tuned=auto|require` path in cli/train.py and cli/serve.py
+- tune/cli.py — the offline search (`python -m dist_mnist_tpu.tune`,
+  wrapped by cli/tune.py and scripts/perf_sweep.py)
+
+See docs/TUNING.md for the knob catalog, store layout and key
+semantics.
+"""
+
+from dist_mnist_tpu.tune.search import SearchResult, Trial, successive_halving
+from dist_mnist_tpu.tune.spec import KNOBS, TunableSpec, knob_names
+from dist_mnist_tpu.tune.store import (
+    ENV_TUNED_DIR,
+    TunedConfigMissError,
+    TunedConfigStore,
+    apply_tuned,
+    make_entry,
+    tuning_key,
+)
+
+__all__ = [
+    "ENV_TUNED_DIR",
+    "KNOBS",
+    "SearchResult",
+    "Trial",
+    "TunableSpec",
+    "TunedConfigMissError",
+    "TunedConfigStore",
+    "apply_tuned",
+    "knob_names",
+    "make_entry",
+    "successive_halving",
+    "tuning_key",
+]

@@ -1,0 +1,7 @@
+"""`python -m dist_mnist_tpu.tune` — see tune/cli.py."""
+
+import sys
+
+from dist_mnist_tpu.tune.cli import main
+
+sys.exit(main())

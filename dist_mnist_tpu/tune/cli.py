@@ -1,0 +1,123 @@
+"""Offline knob search: `python -m dist_mnist_tpu.tune`.
+
+Runs successive halving over registered knobs and commits the winners
+(with embedded evidence) to a TunedConfigStore, keyed to THIS process's
+geometry — the config you pass, the mesh it builds, the backend and jax
+version it runs under. Train/serve runs on the same geometry then pick
+the winners up via `--tuned=auto`.
+
+One JSON line per trial plus a final summary line, the
+scripts/perf_sweep.py output discipline (that script is now a shim over
+this module). Deterministic knobs run anywhere; timed knobs
+(`prefetch_depth`, `scan_chunk`) meter wall-clock and belong on the
+real chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dist_mnist_tpu.tune.spec import KNOBS
+
+
+def _selected(spec_arg: str):
+    if spec_arg == "all":
+        return list(KNOBS)
+    if spec_arg == "deterministic":
+        return [n for n, s in KNOBS.items() if s.deterministic]
+    names = [n.strip() for n in spec_arg.split(",") if n.strip()]
+    unknown = [n for n in names if n not in KNOBS]
+    if unknown:
+        raise SystemExit(
+            f"unknown knob(s) {unknown}; registered: {sorted(KNOBS)}")
+    return names
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="successive-halving search over registered tunables")
+    ap.add_argument("--knobs", default="deterministic",
+                    help="comma list of knob names, or 'deterministic' "
+                         "(default: the CI-safe subset) or 'all'")
+    ap.add_argument("--store", default=None,
+                    help="TunedConfigStore directory (default: "
+                         "$DIST_MNIST_TPU_TUNED_DIR; omit both to search "
+                         "without persisting)")
+    ap.add_argument("--config", default="mlp_mnist",
+                    help="config whose geometry keys the store entry")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--budget", type=int, default=32,
+                    help="round-0 objective budget (stream length); "
+                         "doubles every halving round")
+    # perf_sweep.py compatibility surface (the timed scan/input legs)
+    ap.add_argument("--steps", type=int, default=2000,
+                    help="timed-knob step budget (scan_chunk / "
+                         "prefetch_depth legs)")
+    ap.add_argument("--batch", type=int, default=200,
+                    help="global batch for the timed train legs")
+    ap.add_argument("--model", default="lenet5",
+                    help="model for the timed scan_chunk leg")
+    ap.add_argument("--data-dir", default="/tmp/mnist-data")
+    args = ap.parse_args(argv)
+
+    from dist_mnist_tpu.cluster.mesh import make_mesh
+    from dist_mnist_tpu.configs import get_config
+    from dist_mnist_tpu.tune.objectives import (
+        TuneObjectiveUnavailable,
+        build_objective,
+    )
+    from dist_mnist_tpu.tune.search import successive_halving
+    from dist_mnist_tpu.tune.store import (
+        TunedConfigStore,
+        make_entry,
+        tuning_key,
+        _resolve_store_dir,
+    )
+
+    cfg = get_config(args.config)
+    mesh = make_mesh(cfg.mesh)
+    results = []
+    for name in _selected(args.knobs):
+        spec = KNOBS[name]
+        base = (args.budget if spec.deterministic
+                else max(10, args.steps // 4))
+        try:
+            objective = build_objective(
+                name, mesh=mesh, model=args.model, batch=args.batch,
+                data_dir=args.data_dir)
+        except TuneObjectiveUnavailable as e:
+            print(json.dumps({"knob": name, "skipped": str(e)}),
+                  flush=True)
+            continue
+        res = successive_halving(spec, objective, seed=args.seed,
+                                 base_budget=base)
+        for t in res.trials:
+            print(json.dumps({
+                "knob": name, "candidate": t.candidate, "round": t.round,
+                "budget": t.budget, spec.metric: round(t.score, 6),
+                **t.extra}), flush=True)
+        results.append(res)
+        print(json.dumps({
+            "knob": name, "winner": res.winner,
+            spec.metric: round(res.winner_score, 6),
+            "baseline": round(res.default_score, 6),
+            "vs_default_ratio": round(res.vs_default_ratio, 6),
+            "strictly_beats_default": res.strictly_beats_default,
+        }), flush=True)
+
+    summary = {"knobs_searched": [r.spec.name for r in results]}
+    root = _resolve_store_dir(args.store)
+    if root and results:
+        store = TunedConfigStore(root)
+        key = tuning_key(cfg, mesh)
+        store.save(key, make_entry(cfg, mesh, results))
+        summary.update(store=str(root), key=key,
+                       store_stats=store.stats())
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

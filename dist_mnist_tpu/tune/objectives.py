@@ -1,0 +1,287 @@
+"""Objectives: the bench legs, importable, one per registered knob.
+
+Each builder does the expensive one-time construction (mesh, model,
+sharded state) and returns a closure ``objective(candidate, *, budget,
+seed) -> (score, extra)`` for tune/search.py. They reuse the same
+machinery as the corresponding bench.py stage — `plan_stats` and the
+fsdp mlp leg from `--overlap`, the SeqGrid bucketing arithmetic and the
+`make_varlen_images` height distribution from `--serve`/`--longctx`,
+the `timed_chunks` stop-clock and scan legs from `--input` and
+scripts/perf_sweep.py — as in-process functions, not subprocesses.
+
+Two classes of objective, flagged on the spec:
+
+- deterministic (`overlap_bucket_mb`, `serve_grid`): pure functions of
+  (candidate, budget, seed) — structural plan metadata and seeded
+  bucketing arithmetic. These run in CI, in `bench.py --tune`, and on
+  the CPU mesh, where wall-clock cannot resolve schedule differences
+  (XLA-CPU runs collectives inline) but the structure it would produce
+  is exactly measurable.
+- timed (`prefetch_depth`, `scan_chunk`): device_get stop-clock legs
+  for the offline `cli/tune.py` run on real hardware.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: byte-equivalent toll per gather launch: one more bucket costs the
+#: schedule roughly this much head/tail latency (the classic gradient-
+#: bucketing trade; docs/TUNING.md "Cost models")
+LAUNCH_TOLL_MB = 0.25
+
+#: per grid-cell toll for the serve objective: every (batch, seq) cell
+#: is one more compiled program to prewarm and keep resident against
+#: the serve memory budget (serve/engine.py prewarm / ServeMemoryBudget)
+CELL_TOLL = 0.02
+
+
+class TuneObjectiveUnavailable(RuntimeError):
+    """This geometry cannot measure the knob (e.g. 1 chip: no fsdp
+    communication exists, there is nothing to bucket)."""
+
+
+# -------------------------------------------------------- overlap_bucket_mb
+
+def overlap_cost_objective(mesh=None, *, data_dir: str = "/tmp/mnist-data"):
+    """Objective for `overlap_bucket_mb`: the byte-denominated schedule
+    cost of the REAL gather plan (parallel/overlap.plan_stats) for the
+    same fsdp mlp leg `bench.py --overlap` times — mean bucket size (the
+    head-of-line gather that cannot hide behind compute) plus a fixed
+    per-launch toll per bucket. Deterministic: plan metadata, no clock.
+    `budget`/`seed` are accepted for protocol parity and recorded."""
+    import jax
+
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
+    from dist_mnist_tpu.data import load_dataset
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.parallel.overlap import OverlapConfig, plan_stats
+    from dist_mnist_tpu.parallel.sharding import (
+        FSDP_RULES,
+        shard_train_state,
+    )
+    from dist_mnist_tpu.train import create_train_state
+
+    mesh = mesh if mesh is not None else make_mesh(MeshSpec(data=-1))
+    n_chips = int(mesh.devices.size)
+    if n_chips < 2:
+        raise TuneObjectiveUnavailable(
+            "overlap_bucket_mb needs >= 2 chips: a 1-chip mesh has no "
+            "fsdp communication to bucket (same caveat as bench "
+            "--overlap's single_chip report)")
+    dataset = load_dataset("mnist", data_dir, seed=0)
+    hidden = max(64, 64 * n_chips)  # the bench --overlap leg's sizing
+    with activate(mesh):
+        model = get_model("mlp", hidden_units=hidden)
+        state = create_train_state(model, optim.adam(1e-3),
+                                   jax.random.PRNGKey(0),
+                                   dataset.train_images[:1])
+        state = shard_train_state(state, mesh, FSDP_RULES)
+    params = state.params
+
+    def objective(candidate, *, budget: int, seed: int):
+        bucket_mb = float(candidate)  # lint: ok[host-sync] host-side candidate arithmetic, no device value involved
+        stats = plan_stats(params, mesh, FSDP_RULES,
+                           OverlapConfig(bucket_mb=bucket_mb))
+        n_buckets = int(stats["buckets"])
+        gathered_mb = stats["gathered_bytes"] / 2**20
+        head_mb = gathered_mb / max(1, n_buckets)
+        score = head_mb + LAUNCH_TOLL_MB * n_buckets
+        return score, {
+            "n_buckets": n_buckets,
+            "gathered_mbytes": round(gathered_mb, 3),
+            "head_mbytes": round(head_mb, 3),
+            "launch_toll_mb": LAUNCH_TOLL_MB,
+            "chips": n_chips,
+            "hidden_units": hidden,
+            "budget": budget,
+            "seed": seed,
+        }
+
+    return objective
+
+
+# --------------------------------------------------------------- serve_grid
+
+def serve_grid_objective(image_shape=(28, 28, 1), patch: int = 4):
+    """Objective for `serve_grid` (max_batch, seq_buckets spec): replay
+    a seeded variable-height request stream through the real SeqGrid
+    bucketing arithmetic (serve/zoo.py) and charge every padded token
+    slot. The stream uses the SAME height distribution as the longctx
+    loadgen (`make_varlen_images`: patch-multiple heights uniform in
+    [patch, native]) and a seeded dispatch-size stream for the batch
+    dimension. Score = token-pad ratio x batch-slot-pad ratio + a
+    per-grid-cell toll (prewarm/residency). Pure arithmetic on the
+    seeded stream: deterministic on every backend."""
+    from dist_mnist_tpu.serve.zoo import parse_seq_buckets
+
+    native_h = int(image_shape[0])
+
+    def objective(candidate, *, budget: int, seed: int):
+        max_batch, spec = int(candidate[0]), str(candidate[1])
+        grid = parse_seq_buckets(spec, image_shape, patch)
+        rng = np.random.default_rng(seed)
+        # heights: make_varlen_images' distribution, arrival sizes: up
+        # to 1.5x the stock window so every max_batch has to split some
+        ks = rng.integers(1, native_h // patch + 1, size=budget)
+        arrivals = rng.integers(1, 97, size=budget)
+        if grid is not None:
+            real_tok = sum(grid.n_tokens(int(k) * patch) for k in ks)
+            pad_tok = sum(grid.n_tokens(grid.bucket_for(int(k) * patch))
+                          for k in ks)
+            n_heights = len(grid.heights)
+        else:  # native-only: every request pays the full image
+            per = (native_h // patch) * (image_shape[1] // patch)
+            real_tok = sum(int(k) * (image_shape[1] // patch) for k in ks)
+            pad_tok = per * len(ks)
+            n_heights = 1
+        real_slots, pad_slots = 0, 0
+        for g in arrivals:
+            g = int(g)
+            real_slots += g
+            full, rem = divmod(g, max_batch)
+            pad_slots += full * max_batch
+            if rem:
+                pad_slots += 1 << (rem - 1).bit_length()
+        n_batch_buckets = max_batch.bit_length()  # 1,2,4,...,max_batch
+        n_cells = n_batch_buckets * n_heights
+        tok_ratio = pad_tok / real_tok
+        slot_ratio = pad_slots / real_slots
+        score = tok_ratio * slot_ratio + CELL_TOLL * n_cells
+        return score, {
+            "token_pad_ratio": round(tok_ratio, 4),
+            "batch_slot_pad_ratio": round(slot_ratio, 4),
+            "grid_cells": n_cells,
+            "cell_toll": CELL_TOLL,
+            "requests": budget,
+            "seed": seed,
+        }
+
+    return objective
+
+
+# ----------------------------------------------------- timed, offline-only
+
+def input_feed_objective(mesh=None, *, batch: int = 512,
+                         data_dir: str = "/tmp/mnist-data"):
+    """Objective for `prefetch_depth` (timed; offline): ms/step of the
+    real train step fed through a DevicePrefetcher ring at the candidate
+    depth — the `bench.py --input` question, asked per depth."""
+    import jax
+
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
+    from dist_mnist_tpu.data import ShardedBatcher, load_dataset
+    from dist_mnist_tpu.data.prefetch import DevicePrefetcher
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state, make_train_step
+    from dist_mnist_tpu.utils.timing import timed_chunks
+
+    mesh = mesh if mesh is not None else make_mesh(MeshSpec(data=-1))
+    n_chips = int(mesh.devices.size)
+    dataset = load_dataset("mnist", data_dir, seed=0)
+    optimizer = optim.adam(1e-3)
+    with activate(mesh):
+        model = get_model("mlp")
+        step = make_train_step(model, optimizer, mesh)
+
+    def fresh_state():
+        # the jitted step donates its state argument, so every trial must
+        # start from freshly materialized buffers, never a shared state0
+        state = create_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   dataset.train_images[:1])
+        return shard_train_state(state, mesh)
+
+    def objective(candidate, *, budget: int, seed: int):
+        depth = int(candidate)
+        with activate(mesh):
+            batcher = ShardedBatcher(dataset, batch, mesh, seed=seed)
+            feed = DevicePrefetcher(batcher, depth=depth) if depth \
+                else batcher
+            it = iter(feed)
+            try:
+                dt, _, loss = timed_chunks(
+                    lambda s: step(s, next(it)), fresh_state(), budget)
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()  # drain + join the prefetch worker
+        ms = dt / budget * 1e3
+        return ms, {"final_loss": round(loss, 4), "depth": depth,
+                    "timed_steps": budget, "chips": n_chips}
+
+    return objective
+
+
+def scan_chunk_objective(mesh=None, *, model_name: str = "lenet5",
+                         batch: int = 200,
+                         data_dir: str = "/tmp/mnist-data"):
+    """Objective for `scan_chunk` (timed; offline): steps/sec/chip of
+    the compiled multi-step scan at the candidate chunk size, candidate
+    0 = the per-step host-feed path — the scripts/perf_sweep.py sweep
+    body, lifted here so the script could become a shim."""
+    import jax
+
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
+    from dist_mnist_tpu.data import (
+        DeviceDataset,
+        ShardedBatcher,
+        load_dataset,
+    )
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.parallel.sharding import shard_train_state
+    from dist_mnist_tpu.train import create_train_state, make_train_step
+    from dist_mnist_tpu.train.step import make_scanned_train_fn
+    from dist_mnist_tpu.utils.timing import timed_chunks
+
+    mesh = mesh if mesh is not None else make_mesh(MeshSpec(data=-1))
+    n_chips = int(mesh.devices.size)
+    dataset = load_dataset("mnist", data_dir, seed=0)
+    optimizer = optim.adam(1e-3)
+    with activate(mesh):
+        model = get_model(model_name)
+        dd = DeviceDataset(dataset, mesh)
+
+    def fresh_state():
+        state = create_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   dataset.train_images[:1])
+        return shard_train_state(state, mesh)
+
+    def objective(candidate, *, budget: int, seed: int):
+        chunk = int(candidate)
+        with activate(mesh):
+            if chunk:
+                run = make_scanned_train_fn(model, optimizer, mesh, dd,
+                                            batch, chunk)
+                n_chunks = max(1, budget // chunk)
+                dt, _, loss = timed_chunks(run, fresh_state(), n_chunks)
+                steps = n_chunks * chunk
+            else:
+                step = make_train_step(model, optimizer, mesh)
+                it = iter(ShardedBatcher(dataset, batch, mesh, seed=seed))
+                dt, _, loss = timed_chunks(
+                    lambda s: step(s, next(it)), fresh_state(), budget)
+                steps = budget
+        return steps / dt / n_chips, {
+            "final_loss": round(loss, 4), "scan_chunk": chunk,
+            "timed_steps": steps, "chips": n_chips}
+
+    return objective
+
+
+def build_objective(name: str, *, mesh=None, model: str = "lenet5",
+                    batch: int = 200, data_dir: str = "/tmp/mnist-data"):
+    """Objective factory by knob name (the cli/tune.py dispatch)."""
+    if name == "overlap_bucket_mb":
+        return overlap_cost_objective(mesh, data_dir=data_dir)
+    if name == "serve_grid":
+        return serve_grid_objective()
+    if name == "prefetch_depth":
+        return input_feed_objective(mesh, data_dir=data_dir)
+    if name == "scan_chunk":
+        return scan_chunk_objective(mesh, model_name=model, batch=batch,
+                                    data_dir=data_dir)
+    raise KeyError(f"no objective registered for knob {name!r}")

@@ -1,0 +1,153 @@
+"""Seeded successive halving over a TunableSpec's candidate ladder.
+
+Round r scores every surviving candidate with an objective budget of
+``base_budget * 2**r`` (stream length / timed steps — whatever the
+objective meters), keeps the better half, and repeats until one
+survivor remains. The trial runner is deliberately SYNCHRONOUS: trials
+share the process's devices, so parallel trials would contend for them
+and perturb each other's measurements — and a single-threaded engine is
+trivially deterministic across invocations, which the store's evidence
+claims depend on (tests pin a two-invocation replay).
+
+Every round re-derives its stream seed as ``seed + round``, so the
+final round's survivors — and the stock default, which is ALWAYS
+re-scored at the final round's (budget, seed) even if halving
+eliminated it earlier — are compared on the same stream. That final
+same-stream pair is the "winner strictly beats default" evidence
+`bench.py --tune` asserts and the store embeds.
+
+Objectives are callables ``objective(candidate, *, budget, seed) ->
+(score, extra_dict)`` — see tune/objectives.py for the bench-leg-backed
+ones.
+
+Journal events (all no-ops without an installed journal):
+``tuning/search_start``, one ``tuning/trial`` per scored candidate,
+``tuning/winner`` at the end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.tune.spec import TunableSpec
+
+__all__ = ["Trial", "SearchResult", "successive_halving"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Trial:
+    """One scored (candidate, budget) leg."""
+
+    candidate: object
+    round: int
+    budget: int
+    score: float
+    extra: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchResult:
+    spec: TunableSpec
+    winner: object
+    winner_score: float
+    default_score: float
+    final_budget: int
+    final_seed: int
+    rounds: int
+    seed: int
+    trials: tuple
+
+    @property
+    def strictly_beats_default(self) -> bool:
+        return self.spec.better(self.winner_score, self.default_score)
+
+    @property
+    def vs_default_ratio(self) -> float:
+        """winner/default for lower_is_better metrics (inverted
+        otherwise): < 1.0 always means the tuned value wins."""
+        if self.default_score == 0:
+            return 1.0
+        r = self.winner_score / self.default_score
+        return r if self.spec.direction == "lower_is_better" else 1.0 / r
+
+    def evidence(self) -> dict:
+        """The embedded-evidence dict the TunedConfigStore persists and
+        `tuning/applied` replays (metric, value, baseline, bench stage,
+        timestamp — the acceptance-criteria fields)."""
+        return {
+            "metric": self.spec.metric,
+            "direction": self.spec.direction,
+            "value": self.winner_score,
+            "baseline": self.default_score,
+            "default": self.spec.default,
+            "bench_stage": self.spec.bench_stage,
+            "budget": self.final_budget,
+            "stream_seed": self.final_seed,
+            "rounds": self.rounds,
+            "trials": len(self.trials),
+            "seed": self.seed,
+            "measured_at": time.time(),
+        }
+
+
+def successive_halving(spec: TunableSpec, objective, *, seed: int = 0,
+                       base_budget: int = 32) -> SearchResult:
+    """Run the search; see the module docstring for the protocol."""
+    survivors = list(spec.candidates)
+    if not survivors:
+        raise ValueError(f"{spec.name}: empty candidate ladder")
+    events.emit("tuning/search_start", knob=spec.name,
+                candidates=len(survivors), metric=spec.metric,
+                direction=spec.direction, seed=seed,
+                base_budget=base_budget)
+    trials: list[Trial] = []
+    rnd, budget, round_seed = 0, base_budget, seed
+    last_scores: dict = {}
+    while True:
+        budget = base_budget * (2 ** rnd)
+        round_seed = seed + rnd
+        last_scores = {}
+        for cand in survivors:
+            score, extra = objective(cand, budget=budget, seed=round_seed)
+            # lint: ok[host-sync] objective already stop-clocked/fetched; this is host-side score normalization
+            score = float(score)
+            last_scores[cand] = score
+            trials.append(Trial(cand, rnd, budget, score, extra))
+            events.emit("tuning/trial", knob=spec.name, candidate=cand,
+                        round=rnd, budget=budget, metric=spec.metric,
+                        score=round(score, 6))
+        if len(survivors) == 1:
+            break
+        # stable sort: ties resolve by ladder order, deterministically
+        survivors.sort(
+            key=lambda c: (last_scores[c]
+                           if spec.direction == "lower_is_better"
+                           else -last_scores[c]))
+        survivors = survivors[:-(-len(survivors) // 2) or 1]
+        rnd += 1
+    winner = survivors[0]
+    winner_score = last_scores[winner]
+    # baseline leg: the stock default at the final (budget, seed) — the
+    # same stream the winner's final score came from
+    if winner == spec.default:
+        default_score = winner_score
+    else:
+        default_score, _ = objective(spec.default, budget=budget,
+                                     seed=round_seed)
+        # lint: ok[host-sync] same: host-side normalization of an already-fetched score
+        default_score = float(default_score)
+        trials.append(Trial(spec.default, rnd, budget, default_score,
+                            {"baseline_leg": True}))
+    res = SearchResult(
+        spec=spec, winner=winner, winner_score=winner_score,
+        default_score=default_score, final_budget=budget,
+        final_seed=round_seed, rounds=rnd + 1, seed=seed,
+        trials=tuple(trials))
+    events.emit("tuning/winner", knob=spec.name, winner=winner,
+                metric=spec.metric, score=round(winner_score, 6),
+                baseline=round(default_score, 6),
+                vs_default_ratio=round(res.vs_default_ratio, 6),
+                strictly_beats_default=res.strictly_beats_default)
+    return res

@@ -1,0 +1,165 @@
+"""The declared knob space: every tunable registers a `TunableSpec`.
+
+A spec is the contract between three consumers:
+
+- the search engine (tune/search.py) reads `candidates`, `metric`,
+  `direction` and runs successive halving over short seeded bench legs;
+- the apply path (tune/store.py apply_tuned) reads `target`,
+  `auto_apply` and `knob_fields()` to decide where a stored winner lands
+  (a Config field, a train runtime parameter, or a serve flag);
+- the graftlint cache-key rule reads `compile_relevant` and cross-checks
+  it against `compilecache/key_fields.py compile_cache_key_fields`: a knob declared
+  compile-relevant must fold into the executable-store key (so a
+  tuner-applied change forces a compile-cache miss), and a runtime-only
+  knob must carry its reason in the rule's TUNER_RUNTIME_ONLY allowlist.
+
+Objectives live in tune/objectives.py, keyed by `name` — the spec is
+pure metadata so the catalog imports without jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: candidate ladders are tuples; a serve-grid candidate is itself a tuple
+#: zipped against `fields` (see TunableSpec.knob_values)
+Candidate = object
+
+
+@dataclasses.dataclass(frozen=True)
+class TunableSpec:
+    """One knob's declared search space and application contract."""
+
+    name: str                  # catalog key; also the stored knob name
+    subsystem: str             # overlap | input | serve | headline
+    candidates: tuple          # the ladder successive halving prunes
+    default: Candidate         # the stock default the winner must beat
+    metric: str                # objective name recorded in the evidence
+    bench_stage: str           # which bench leg family measures it
+    target: str                # config | train_runtime | serve
+    direction: str = "lower_is_better"
+    #: True: the applied value changes the traced program, so it MUST be
+    #: part of compile_cache_key_fields (lint-enforced); False: runtime
+    #: only, allowlisted with a reason in analysis/rules/cache_key.py
+    compile_relevant: bool = False
+    #: True: the objective is a deterministic function of (candidate,
+    #: budget, seed) on any backend — safe for CI and `bench.py --tune`;
+    #: False: wall-clock timed, offline `cli/tune.py` only
+    deterministic: bool = True
+    #: False: searchable offline but never applied by `--tuned=auto`
+    #: (the doc says why); True: a store hit applies it
+    auto_apply: bool = True
+    #: multi-valued knobs: candidate tuples zip against these stored
+    #: knob names (e.g. serve_grid -> serve_max_batch, serve_seq_buckets)
+    fields: tuple = ()
+    doc: str = ""
+
+    def knob_values(self, candidate) -> dict:
+        """Map a candidate to the {stored_knob_name: value} dict the
+        store persists and apply_tuned reads."""
+        if self.fields:
+            return dict(zip(self.fields, candidate))
+        return {self.name: candidate}
+
+    def better(self, a: float, b: float) -> bool:
+        """True when score `a` beats score `b` under `direction`."""
+        return a < b if self.direction == "lower_is_better" else a > b
+
+
+#: every registered knob. Ladders are deliberately short: successive
+#: halving keeps total trial count ~2x the ladder length.
+KNOBS: dict[str, TunableSpec] = {
+    "overlap_bucket_mb": TunableSpec(
+        name="overlap_bucket_mb",
+        subsystem="overlap",
+        candidates=(0.5, 1.0, 2.0, 4.0, 8.0),
+        default=4.0,  # configs.Config.overlap_bucket_mb
+        metric="exposed_gather_cost_mb",
+        bench_stage="overlap",
+        target="config",
+        compile_relevant=True,
+        doc=(
+            "fsdp gather-bucket granularity (parallel/overlap.py). The "
+            "objective is a byte-denominated schedule cost over the REAL "
+            "gather plan (plan_stats on the live mesh): mean bucket size "
+            "(the head-of-line gather nothing can hide behind) plus a "
+            "fixed per-launch toll per bucket. Byte-denominated because "
+            "it is the stand-in for comm_exposed_ms_per_step that stays "
+            "deterministic on the CPU lane, where XLA runs collectives "
+            "inline and wall-clock cannot resolve the schedule (the "
+            "bench --overlap timing_resolves_overlap caveat)."),
+    ),
+    "serve_grid": TunableSpec(
+        name="serve_grid",
+        subsystem="serve",
+        fields=("serve_max_batch", "serve_seq_buckets"),
+        candidates=(
+            (64, ""),                       # stock: native-only, pre-zoo
+            (64, "auto"),                   # power-of-two height ladder
+            (64, "4,8,12,16,20,24,28"),     # every patch multiple
+            (32, "auto"),
+            (128, "auto"),
+            (32, "4,8,12,16,20,24,28"),
+        ),
+        default=(64, ""),  # cli/serve.py --max_batch/--seq_buckets defaults
+        metric="serve_padded_slot_ratio",
+        bench_stage="serve",
+        target="serve",
+        compile_relevant=False,  # flows through the zoo's per-bucket keys
+        doc=(
+            "the serve zoo's (batch, seq) bucket grid (serve/zoo.py). The "
+            "objective replays a seeded variable-height request stream "
+            "(the same height distribution as loadgen.make_varlen_images) "
+            "through the real SeqGrid bucketing arithmetic: padded slots "
+            "over real slots across both grid dimensions, plus a small "
+            "per-cell toll for the prewarm/residency cost of every extra "
+            "compiled program (the ServeMemoryBudget pressure). Each grid "
+            "cell compiles under its own zoo executable key, so this knob "
+            "never touches the train-step cache key."),
+    ),
+    "prefetch_depth": TunableSpec(
+        name="prefetch_depth",
+        subsystem="input",
+        candidates=(1, 2, 4, 8),
+        default=2,  # cli/train.py --prefetch_depth default
+        metric="input_ms_per_step",
+        bench_stage="input",
+        target="train_runtime",
+        compile_relevant=False,
+        deterministic=False,  # wall-clock feed timing; offline only
+        doc=(
+            "device-prefetch ring depth for the host input paths "
+            "(data/prefetch.py). Runtime-only: the ring lives on the "
+            "host side of the feed, the traced program is identical at "
+            "every depth, so it is allowlisted out of the compile key "
+            "(analysis/rules/cache_key.py TUNER_RUNTIME_ONLY)."),
+    ),
+    "scan_chunk": TunableSpec(
+        name="scan_chunk",
+        subsystem="headline",
+        candidates=(10, 100, 500),
+        default=0,  # one program per step
+        metric="steps_per_sec_per_chip",
+        direction="higher_is_better",
+        bench_stage="headline",
+        target="train_runtime",
+        compile_relevant=True,  # keyed via compile_cache_key_fields
+        deterministic=False,
+        auto_apply=False,
+        doc=(
+            "multi-step lax.scan chunking (the perf_sweep.py sweep, now "
+            "a tune objective). Not auto-applied: a nonzero chunk "
+            "requires --input_pipeline=device|device_sharded — flipping "
+            "the input contract is an operator decision, not a store "
+            "hit; the offline search reports the winner and the flag "
+            "applies it."),
+    ),
+}
+
+
+def knob_names() -> tuple:
+    """All stored knob names across the catalog (flattened fields)."""
+    out = []
+    for spec in KNOBS.values():
+        out.extend(spec.fields if spec.fields else (spec.name,))
+    return tuple(out)

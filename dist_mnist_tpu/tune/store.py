@@ -1,0 +1,261 @@
+"""TunedConfigStore: persisted knob winners, keyed like the executable cache.
+
+An entry is one JSON file per geometry key holding the winning knob
+values AND the measurement evidence that justified them (metric,
+winner/baseline scores, bench stage, search shape, timestamp). The key
+is `compilecache/store.cache_key` over `compilecache/key_fields.py
+compile_cache_key_fields` — model config, mesh shape, sharding, dtype,
+backend, jax/jaxlib versions — so a tuned value can never be silently
+applied to a geometry it wasn't measured on: change the mesh, the
+backend or the jax version and the lookup misses.
+
+Two deliberate deviations from the raw compile key:
+
+- the tuned knobs THEMSELVES (`overlap`/`overlap_bucket_mb`/
+  `overlap_chunk`) are dropped from the key fields. The lookup happens
+  with the launch-time config, before the winner is applied; if the
+  knob's own value were keyed, a stored winner could only ever match a
+  run already launched with it.
+- a `kind: "tuned"` field separates this namespace from the executable
+  store's step keys.
+
+Failure semantics mirror `compilecache.ExecutableStore`: atomic
+tmp+rename writes, a corrupt or truncated entry is quarantined
+(unlinked, counted) and reads as a miss — never a crash — and a failed
+save degrades to a warning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+
+from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.tune.spec import KNOBS
+
+log = logging.getLogger("dist_mnist_tpu.tune")
+
+ENTRY_SUFFIX = ".tuned.json"
+TMP_PREFIX = ".tmp-"
+
+#: supervisors inject a shared store dir across restarts, like the journal
+ENV_TUNED_DIR = "DIST_MNIST_TPU_TUNED_DIR"
+
+#: in-flight tmp files (leak-checked by tests/conftest.py, same contract
+#: as compilecache.store._PENDING_TMP)
+_PENDING_TMP: set = set()
+
+#: key fields that ARE tuned knobs (or their master switch) — excluded
+#: from the tuning key so a winner can match the run it should improve
+TUNED_KEY_EXCLUDES = ("overlap", "overlap_bucket_mb", "overlap_chunk")
+
+
+class TunedConfigMissError(RuntimeError):
+    """--tuned=require and the store has no entry for this geometry."""
+
+
+def tuned_key_fields(cfg, mesh) -> dict:
+    """The geometry fields the tuning key hashes (see module docstring
+    for why the tuned knobs themselves are excluded)."""
+    # key_fields, not cli.train: importing the train CLI from a serve or
+    # tune process would re-run its flags.DEFINE_* block (DuplicateFlagError
+    # under `python -m`, --config collision from cli/serve.py)
+    from dist_mnist_tpu.compilecache.key_fields import compile_cache_key_fields
+
+    fields = compile_cache_key_fields(cfg, mesh)
+    for name in TUNED_KEY_EXCLUDES:
+        fields.pop(name, None)
+    fields["kind"] = "tuned"
+    return fields
+
+
+def tuning_key(cfg, mesh, **overrides) -> str:
+    """Store key for (cfg, mesh) on the current backend/jax version.
+    `overrides` lets tests pin a foreign backend/jax_version without
+    monkeypatching jax (cache_key folds explicit fields over its
+    auto-merged ones)."""
+    from dist_mnist_tpu.compilecache.store import cache_key
+
+    return cache_key({**tuned_key_fields(cfg, mesh), **overrides})
+
+
+class TunedConfigStore:
+    """Directory of `<key>.tuned.json` winner entries."""
+
+    def __init__(self, root):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._corrupt = 0
+        self._saves = 0
+        self._save_errors = 0
+
+    def _path(self, key: str) -> Path:
+        return self.root / f"{key}{ENTRY_SUFFIX}"
+
+    def load(self, key: str) -> dict | None:
+        """The entry dict, or None on miss. A corrupt/truncated entry is
+        quarantined (unlinked + counted) and reported as a miss."""
+        path = self._path(key)
+        if not path.exists():
+            with self._lock:
+                self._misses += 1
+            return None
+        try:
+            entry = json.loads(path.read_text())
+            if not isinstance(entry, dict) or not isinstance(
+                    entry.get("knobs"), dict):
+                raise ValueError("entry is not a {knobs: {...}} object")
+        except (ValueError, OSError) as e:
+            log.warning("tuned store: quarantining corrupt entry %s (%s)",
+                        path.name, e)
+            try:
+                path.unlink()
+            except OSError:
+                pass
+            with self._lock:
+                self._corrupt += 1
+                self._misses += 1
+            return None
+        with self._lock:
+            self._hits += 1
+        return entry
+
+    def save(self, key: str, entry: dict) -> int:
+        """Atomically persist `entry`; returns bytes written (0 on a
+        failed save — tuning evidence is an aid, never a crash)."""
+        path = self._path(key)
+        tmp = self.root / f"{TMP_PREFIX}{key}-{os.getpid()}"
+        blob = json.dumps({"key": key, **entry}, indent=1, sort_keys=True)
+        _PENDING_TMP.add(tmp)
+        try:
+            tmp.write_text(blob)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("tuned store: could not save %s (%s)", path.name, e)
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+            with self._lock:
+                self._save_errors += 1
+            return 0
+        finally:
+            _PENDING_TMP.discard(tmp)
+        with self._lock:
+            self._saves += 1
+        return len(blob)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "hits": self._hits,
+                "misses": self._misses,
+                "corrupt": self._corrupt,
+                "saves": self._saves,
+                "save_errors": self._save_errors,
+            }
+        out["entries"] = len(list(self.root.glob(f"*{ENTRY_SUFFIX}")))
+        return out
+
+
+def make_entry(cfg, mesh, results) -> dict:
+    """Store entry from per-spec `SearchResult`s (tune/search.py): the
+    flattened winning knob values plus per-knob embedded evidence."""
+    knobs: dict = {}
+    evidence: dict = {}
+    for res in results:
+        knobs.update(res.spec.knob_values(res.winner))
+        evidence[res.spec.name] = res.evidence()
+    import jax
+    import jaxlib
+
+    return {
+        "knobs": knobs,
+        "evidence": evidence,
+        "fields": {k: repr(v) for k, v in
+                   sorted(tuned_key_fields(cfg, mesh).items())},
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "jaxlib_version": getattr(jaxlib.version, "__version__", "unknown"),
+        "created_at": time.time(),
+    }
+
+
+def _resolve_store_dir(store_dir) -> str | None:
+    return store_dir or os.environ.get(ENV_TUNED_DIR)
+
+
+def apply_tuned(cfg, mesh, *, mode: str = "auto", store_dir=None,
+                protect=(), subsystem: str = "train"):
+    """`--tuned` lookup+apply: returns `(cfg, runtime_knobs)`.
+
+    On a key hit, every auto-apply knob whose spec targets `subsystem`
+    ("train" -> config + train_runtime knobs, "serve" -> serve knobs)
+    and is not in `protect` (names the operator pinned with an explicit
+    flag) is applied — config knobs via dataclasses.replace, the rest
+    returned in `runtime_knobs` for the caller to thread through. Each
+    application emits a `tuning/applied` journal event carrying the
+    stored evidence; a miss emits `tuning/stale_key` and falls back to
+    defaults (`mode="auto"`) or raises (`mode="require"`).
+    `mode="off"` is handled by the CALLER never invoking this — the off
+    path stays bit-identical to pre-tuner behavior by not importing it.
+    """
+    if mode not in ("auto", "require"):
+        raise ValueError(f"tuned mode must be auto|require, got {mode!r}")
+    root = _resolve_store_dir(store_dir)
+    targets = (("config", "train_runtime") if subsystem == "train"
+               else ("serve",))
+    if root is None:
+        if mode == "require":
+            raise TunedConfigMissError(
+                "--tuned=require but no tuned-config store is configured "
+                f"(--tuned_dir / ${ENV_TUNED_DIR})")
+        return cfg, {}
+    key = tuning_key(cfg, mesh)
+    entry = TunedConfigStore(root).load(key)
+    if entry is None:
+        events.emit("tuning/stale_key", key=key, store=str(root),
+                    mode=mode, subsystem=subsystem)
+        if mode == "require":
+            raise TunedConfigMissError(
+                f"--tuned=require but the store at {root} has no entry "
+                f"for key {key} (this model/mesh/backend/jax-version "
+                "geometry was never tuned — run cli/tune.py on it, or "
+                "drop to --tuned=auto)")
+        return cfg, {}
+    stored = entry["knobs"]
+    evidence = entry.get("evidence", {})
+    config_updates: dict = {}
+    runtime_knobs: dict = {}
+    for spec in KNOBS.values():
+        if not spec.auto_apply or spec.target not in targets:
+            continue
+        names = spec.fields if spec.fields else (spec.name,)
+        applied = {n: stored[n] for n in names
+                   if n in stored and n not in protect}
+        if not applied:
+            continue
+        if spec.target == "config":
+            config_updates.update(applied)
+        else:
+            runtime_knobs.update(applied)
+        ev = evidence.get(spec.name, {})
+        events.emit(
+            "tuning/applied", key=key, knob=spec.name,
+            value=applied if spec.fields else next(iter(applied.values())),
+            metric=ev.get("metric", spec.metric),
+            measured=ev.get("value"), baseline=ev.get("baseline"),
+            bench_stage=ev.get("bench_stage", spec.bench_stage),
+            measured_at=ev.get("measured_at", entry.get("created_at")),
+        )
+    if config_updates:
+        cfg = dataclasses.replace(cfg, **config_updates)
+    return cfg, runtime_knobs

@@ -1,13 +1,16 @@
 #!/usr/bin/env python
 """Perf sweep for the headline bench — run on the real chip.
 
-Times the LeNet-5 step (the BASELINE.md metric) across the knobs that
-matter, one JSON line per variant, so regressions/wins are attributable:
+Now a thin shim over the persistent autotuner (`dist_mnist_tpu/tune`):
+the old hand-rolled sweep loops became registered tunables with
+successive-halving search, so the knob table refresh and the tuned-config
+store are fed by ONE engine instead of two drifting copies. The timed
+knobs this script sweeps (`scan_chunk` step-dispatch granularity,
+`prefetch_depth` input feed) meter wall-clock and belong on the real
+chip — the deterministic knobs run everywhere via `bench.py --tune`.
 
-- step dispatch: per-step fused vs lax.scan chunks of {10, 100, 500}
-- compute dtype: bfloat16 vs float32
-- input path: fused on-device sampling vs host feed (ShardedBatcher)
-- remat on/off (memory-for-FLOPs; should be ~neutral for LeNet)
+Output discipline is unchanged: one JSON line per trial plus a summary
+line, so measure_all.sh's metric-line harvest keeps working.
 
 Usage: python scripts/perf_sweep.py [--steps 2000] [--batch 200]
 """
@@ -15,7 +18,6 @@ Usage: python scripts/perf_sweep.py [--steps 2000] [--batch 200]
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
@@ -24,19 +26,15 @@ import sys
 # .axon_site entry that registers the TPU platform plugin in this image)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-
-
-# the axon-hardened device_get stop-clock (single definition; the loss it
-# returns is printed per variant as an executed-for-real sanity check)
-from dist_mnist_tpu.utils.timing import timed_chunks as time_variant  # noqa: E402
-
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=2000)
     ap.add_argument("--batch", type=int, default=200)
     ap.add_argument("--model", default="lenet5")
+    ap.add_argument("--store", default=None,
+                    help="TunedConfigStore dir (default: "
+                         "$DIST_MNIST_TPU_TUNED_DIR)")
     args = ap.parse_args()
 
     # probe + platform override preamble shared with bench (bench.py):
@@ -45,81 +43,21 @@ def main():
 
     probe_or_exit("perf_sweep")
 
+    import jax
+
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-    import jax.numpy as jnp
+    from dist_mnist_tpu.tune.cli import main as tune_main
 
-    from dist_mnist_tpu import optim
-    from dist_mnist_tpu.cluster.mesh import MeshSpec, activate, make_mesh
-    from dist_mnist_tpu.data import DeviceDataset, ShardedBatcher, load_dataset
-    from dist_mnist_tpu.models import get_model
-    from dist_mnist_tpu.parallel.sharding import shard_train_state
-    from dist_mnist_tpu.train import create_train_state, make_train_step
-    from dist_mnist_tpu.train.step import make_scanned_train_fn
-
-    n_chips = jax.device_count()
-    mesh = make_mesh(MeshSpec(data=-1))
-    dataset = load_dataset("mnist", "/tmp/mnist-data", seed=0)
-
-    def fresh_state(model):
-        state = create_train_state(model, optimizer, jax.random.PRNGKey(0),
-                                   dataset.train_images[:1])
-        return shard_train_state(state, mesh)
-
-    optimizer = optim.adam(1e-3)
-    results = []
-
-    with activate(mesh):
-        dd = DeviceDataset(dataset, mesh)
-
-        # -- scan chunk size x dtype x remat --------------------------------
-        for chunk in (10, 100, 500):
-            for dtype_name in ("bfloat16", "float32"):
-                for remat in (False, True):
-                    if remat and (chunk != 100 or dtype_name != "bfloat16"):
-                        continue  # remat: one representative point
-                    model = get_model(
-                        args.model, compute_dtype=getattr(jnp, dtype_name)
-                    )
-                    run = make_scanned_train_fn(
-                        model, optimizer, mesh, dd, args.batch, chunk,
-                        remat=remat,
-                    )
-                    n_chunks = max(1, args.steps // chunk)
-                    dt, _, loss = time_variant(run, fresh_state(model),
-                                               n_chunks)
-                    steps = n_chunks * chunk
-                    results.append({
-                        "variant": f"scan{chunk}_{dtype_name}"
-                                   + ("_remat" if remat else ""),
-                        "steps_per_sec_per_chip": round(steps / dt / n_chips, 2),
-                        "final_loss": round(loss, 4),
-                    })
-                    print(json.dumps(results[-1]), flush=True)
-
-        # -- host-feed path (the reference-style per-step feed) -------------
-        model = get_model(args.model)
-        step = make_train_step(model, optimizer, mesh)
-        state = fresh_state(model)
-        batches = iter(ShardedBatcher(dataset, args.batch, mesh, seed=0))
-        n = min(args.steps, 500)
-        # same shared stop-clock as every other number (timed_chunks);
-        # the warmup call consumes one batch, as before
-        dt, state, loss = time_variant(
-            lambda s: step(s, next(batches)), state, n
-        )
-        results.append({
-            "variant": "host_feed_per_step",
-            "steps_per_sec_per_chip": round(n / dt / n_chips, 2),
-            "final_loss": round(loss, 4),
-        })
-        print(json.dumps(results[-1]), flush=True)
-
-    best = max(results, key=lambda r: r["steps_per_sec_per_chip"])
-    print(json.dumps({"best": best, "chips": n_chips,
-                      "global_batch": args.batch}))
+    argv = ["--knobs", "scan_chunk,prefetch_depth",
+            "--steps", str(args.steps),
+            "--batch", str(args.batch),
+            "--model", args.model]
+    if args.store:
+        argv += ["--store", args.store]
+    return tune_main(argv)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -67,6 +67,7 @@ for i in $(seq 1 "$ROUNDS"); do
     run_stage bench_ckpt      900 python bench.py --ckpt --deadline 800
     run_stage bench_coldstart 900 python bench.py --coldstart --deadline 800
     run_stage bench_overlap   900 python bench.py --overlap --deadline 800
+    run_stage bench_tune      900 python bench.py --tune --deadline 800
     run_stage step_ablation   1800 python scripts/step_ablation.py
     run_stage vit_probe       3600 python scripts/vit_probe.py
     run_stage perf_sweep      1800 python scripts/perf_sweep.py

@@ -84,6 +84,14 @@ def format_record(rec: dict) -> str:
         head = (f"step={rec.get('step', '?')} "
                 f"{_fmt_num(rec.get('dur_ms'), 'ms')} ")
         skip = _FIXED + ("step", "dur_ms")
+    elif event == "tuning/applied":
+        # An autotuned knob landed: lead with what changed and the
+        # measured evidence it rode in on (tune/store.py apply_tuned).
+        head = (f"{rec.get('knob', '?')}={rec.get('value', '?')} "
+                f"{rec.get('metric', '?')} "
+                f"{_fmt_num(rec.get('measured'))} vs default "
+                f"{_fmt_num(rec.get('baseline'))} ")
+        skip = _FIXED + ("knob", "value", "metric", "measured", "baseline")
     # journal records are host-stamped when DIST_MNIST_TPU_HOST_ID was set
     # in the emitting process; fold that into the fixed columns so merged
     # fleet journals stay scannable. generation_resize keeps its own

@@ -65,7 +65,9 @@ def _no_leaked_prefetch_workers():
     path skipped its finally), async snapshot writer threads
     (``SnapshotWriter`` — checkpoint/snapshot.py; alive after a test means
     a manager close/wait path was skipped) and peer-replica atomic-write
-    temp files (checkpoint/peer.py `_PENDING_TMP`), metrics-exporter
+    temp files (checkpoint/peer.py `_PENDING_TMP`), tuned-config-store
+    atomic-write temp files (tune/store.py `_PENDING_TMP`),
+    metrics-exporter
     HTTP threads/sockets
     (``ObsExporter*`` serve threads and obs/exporter.py's
     ``_LIVE_EXPORTERS`` — an unclosed exporter holds a bound port for the
@@ -136,6 +138,10 @@ def _no_leaked_prefetch_workers():
         if peer_mod is not None:
             leaked += [f"pending peer tmp {p}"
                        for p in peer_mod._PENDING_TMP]
+        tuned_mod = sys.modules.get("dist_mnist_tpu.tune.store")
+        if tuned_mod is not None:
+            leaked += [f"pending tuned tmp {p}"
+                       for p in tuned_mod._PENDING_TMP]
         leaked += [f"stray tmp dir {p}" for g in _stray_globs
                    for p in tmp_root.glob(g) if p not in before]
         if not leaked:

@@ -156,7 +156,7 @@ def _cache_key_repo(tmp_path, keyed: str) -> Context:
         + [f"    {name}: int = 0" for name in sorted(RUNTIME_ONLY)]) + "\n"
     return repo_of(tmp_path, {
         "dist_mnist_tpu/configs.py": configs,
-        "dist_mnist_tpu/cli/train.py": (
+        "dist_mnist_tpu/compilecache/key_fields.py": (
             "def compile_cache_key_fields(cfg, mesh):\n"
             f"    return {keyed}\n"),
     })
@@ -184,7 +184,7 @@ def test_cache_key_reports_stale_allowlist_entry(tmp_path):
             class Config:
                 model: str = "mlp"
             """,
-        "dist_mnist_tpu/cli/train.py": """\
+        "dist_mnist_tpu/compilecache/key_fields.py": """\
             def compile_cache_key_fields(cfg, mesh):
                 return {"model": cfg.model}
             """,

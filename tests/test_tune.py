@@ -1,0 +1,392 @@
+"""Persistent autotuner: search determinism, the TunedConfigStore's key
+semantics (geometry-keyed like the executable cache, own knobs excluded),
+quarantine-not-crash failure handling, the `--tuned` apply paths, and the
+compile-cache interlock (a tuner-applied compile-relevant knob must force
+an executable-store miss)."""
+
+import dataclasses
+import json
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from dist_mnist_tpu.cli.train import compile_cache_key_fields, run_config
+from dist_mnist_tpu.compilecache.store import cache_key
+from dist_mnist_tpu.configs import get_config
+from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.tune import (
+    KNOBS,
+    TunableSpec,
+    TunedConfigMissError,
+    TunedConfigStore,
+    apply_tuned,
+    knob_names,
+    make_entry,
+    successive_halving,
+    tuning_key,
+)
+from dist_mnist_tpu.tune.objectives import (
+    overlap_cost_objective,
+    serve_grid_objective,
+)
+
+TOY = TunableSpec(
+    name="toy", subsystem="test", candidates=(1, 2, 3, 4), default=1,
+    metric="toy_cost", bench_stage="none", target="train_runtime")
+
+
+def toy_objective(cand, *, budget, seed):
+    # deterministic, budget- and seed-sensitive: minimized at cand=2.5,
+    # so 2 and 3 tie and the stable sort must resolve by ladder order
+    return (cand - 2.5) ** 2 + 1.0 / budget + seed * 1e-9, {"cand": cand}
+
+
+# -- search engine -------------------------------------------------------------
+
+
+def test_successive_halving_winner_and_baseline_leg():
+    res = successive_halving(TOY, toy_objective, seed=3, base_budget=8)
+    assert res.winner == 2  # tie with 3 broken by ladder order
+    assert res.strictly_beats_default
+    assert res.vs_default_ratio < 1.0
+    # the default was eliminated, so it must be re-scored at the FINAL
+    # round's (budget, seed): same stream as the winner's final score
+    baseline = [t for t in res.trials if t.extra.get("baseline_leg")]
+    assert len(baseline) == 1
+    assert baseline[0].budget == res.final_budget
+    assert baseline[0].score == res.default_score
+
+
+def test_successive_halving_deterministic_across_invocations():
+    a = successive_halving(TOY, toy_objective, seed=0, base_budget=8)
+    b = successive_halving(TOY, toy_objective, seed=0, base_budget=8)
+    assert a.winner == b.winner
+    assert a.winner_score == b.winner_score
+    assert [(t.candidate, t.round, t.budget, t.score) for t in a.trials] \
+        == [(t.candidate, t.round, t.budget, t.score) for t in b.trials]
+
+
+def test_higher_is_better_direction():
+    spec = dataclasses.replace(TOY, direction="higher_is_better", default=4)
+    res = successive_halving(
+        spec, lambda c, *, budget, seed: (float(c), {}), seed=0,
+        base_budget=4)
+    assert res.winner == 4  # the default IS the best: no strict beat
+    assert not res.strictly_beats_default
+    assert res.vs_default_ratio == 1.0
+
+
+def test_search_journal_events(tmp_path):
+    prev = events.set_journal(events.RunJournal(tmp_path / "j.jsonl"))
+    try:
+        successive_halving(TOY, toy_objective, seed=0, base_budget=8)
+    finally:
+        events.set_journal(prev).close()
+    recs = [json.loads(line) for line in
+            (tmp_path / "j.jsonl").read_text().splitlines()]
+    kinds = [r["event"] for r in recs]
+    assert kinds[0] == "tuning/search_start"
+    assert kinds[-1] == "tuning/winner"
+    assert kinds.count("tuning/trial") == len(
+        [r for r in recs if "candidate" in r])
+    winner = recs[-1]
+    assert winner["strictly_beats_default"] is True
+    assert winner["vs_default_ratio"] < 1.0
+
+
+# -- objectives (the real machinery, deterministically) ------------------------
+
+
+def test_overlap_objective_deterministic_and_beats_default(mesh8):
+    objective = overlap_cost_objective(mesh8)
+    s1, extra = objective(1.0, budget=32, seed=0)
+    s2, _ = objective(1.0, budget=32, seed=0)
+    assert s1 == s2  # structural cost model: no wall clock in the score
+    assert extra["n_buckets"] >= 1 and extra["gathered_mbytes"] > 0
+    res = successive_halving(KNOBS["overlap_bucket_mb"], objective,
+                             seed=0, base_budget=32)
+    assert res.strictly_beats_default  # the bench.py --tune gate
+
+
+def test_serve_grid_objective_seeded_stream():
+    objective = serve_grid_objective()
+    s1, extra = objective((64, "auto"), budget=64, seed=0)
+    s2, _ = objective((64, "auto"), budget=64, seed=0)
+    s3, _ = objective((64, "auto"), budget=64, seed=1)
+    assert s1 == s2
+    assert s1 != s3  # the stream really is seed-driven
+    assert extra["grid_cells"] > 0
+    res = successive_halving(KNOBS["serve_grid"], objective,
+                             seed=0, base_budget=32)
+    assert res.strictly_beats_default
+    assert res.winner != KNOBS["serve_grid"].default
+
+
+# -- key semantics -------------------------------------------------------------
+
+
+def test_tuning_key_excludes_own_knobs(mesh8):
+    """The lookup happens with the LAUNCH config, before the winner is
+    applied — the tuned knobs' own values must not key the entry."""
+    cfg = get_config("mlp_mnist")
+    base = tuning_key(cfg, mesh8)
+    assert tuning_key(
+        dataclasses.replace(cfg, overlap_bucket_mb=0.5), mesh8) == base
+    assert tuning_key(
+        dataclasses.replace(cfg, overlap=True, overlap_chunk=4),
+        mesh8) == base
+
+
+def test_tuning_key_invalidation(mesh8, mesh_tp):
+    cfg = get_config("mlp_mnist")
+    base = tuning_key(cfg, mesh8)
+    # geometry: mesh shape, model config, batch — all invalidate
+    assert tuning_key(cfg, mesh_tp) != base
+    assert tuning_key(get_config("lenet5_mnist"), mesh8) != base
+    assert tuning_key(
+        dataclasses.replace(cfg, batch_size=32), mesh8) != base
+    # environment: backend / jax version (pinned via cache_key overrides,
+    # the same auto-merged fields a real cross-version run would differ in)
+    assert tuning_key(cfg, mesh8, backend="tpu") != base
+    assert tuning_key(cfg, mesh8, jax_version="0.0.1") != base
+    # and the namespace can never collide with the executable store's keys
+    assert cache_key({"kind": "step",
+                      **compile_cache_key_fields(cfg, mesh8)}) != base
+
+
+def test_store_hit_requires_exact_geometry(tmp_path, mesh8, mesh_tp):
+    cfg = get_config("mlp_mnist")
+    store = TunedConfigStore(tmp_path)
+    store.save(tuning_key(cfg, mesh8), {"knobs": {"overlap_bucket_mb": 0.5}})
+    assert store.load(tuning_key(cfg, mesh8)) is not None
+    assert store.load(tuning_key(cfg, mesh_tp)) is None
+    assert store.load(tuning_key(cfg, mesh8, backend="tpu")) is None
+    assert store.load(tuning_key(cfg, mesh8, jax_version="0.0.1")) is None
+
+
+def test_tuned_compile_relevant_knob_forces_executable_cache_miss(mesh8):
+    """The satellite-1 interlock: applying the tuner's overlap_bucket_mb
+    winner changes compile_cache_key_fields' hash, so a cached serial
+    executable can never serve the tuned schedule."""
+    cfg = get_config("mlp_mnist")
+    tuned_cfg, _ = _apply_poisoned(cfg, mesh8, bucket_mb=0.5)
+    assert tuned_cfg.overlap_bucket_mb == 0.5
+    assert cache_key(compile_cache_key_fields(tuned_cfg, mesh8)) \
+        != cache_key(compile_cache_key_fields(cfg, mesh8))
+    # ...while the TUNING key is unchanged — next launch still hits
+    assert tuning_key(tuned_cfg, mesh8) == tuning_key(cfg, mesh8)
+
+
+def test_every_catalog_knob_is_classified():
+    """Each stored knob name must be either compile-relevant (keyed — the
+    cache-key lint proves it) or runtime-only; and the spec plumbing
+    (knob_values/knob_names) must agree on the flattened names."""
+    flat = set(knob_names())
+    assert {"overlap_bucket_mb", "serve_max_batch", "serve_seq_buckets",
+            "prefetch_depth", "scan_chunk"} == flat
+    for spec in KNOBS.values():
+        assert set(spec.knob_values(spec.default)) == set(
+            spec.fields if spec.fields else (spec.name,))
+
+
+# -- store robustness ----------------------------------------------------------
+
+
+def test_store_roundtrip_and_stats(tmp_path):
+    store = TunedConfigStore(tmp_path)
+    assert store.load("missing") is None
+    n = store.save("k1", {"knobs": {"prefetch_depth": 4}, "evidence": {}})
+    assert n > 0
+    entry = store.load("k1")
+    assert entry["knobs"] == {"prefetch_depth": 4}
+    assert entry["key"] == "k1"
+    stats = store.stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+    assert stats["saves"] == 1 and stats["entries"] == 1
+
+
+@pytest.mark.parametrize("blob", [
+    "not json at all",
+    '{"knobs": {"overlap_bucket_mb": 0.5}',   # truncated mid-write
+    '["knobs"]',                              # json, wrong shape
+    '{"winner": 0.5}',                        # dict, no knobs
+])
+def test_corrupt_entry_quarantined_not_crash(tmp_path, blob):
+    store = TunedConfigStore(tmp_path)
+    path = tmp_path / "bad.tuned.json"
+    path.write_text(blob)
+    assert store.load("bad") is None
+    assert not path.exists()  # quarantined, not left to fail forever
+    stats = store.stats()
+    assert stats["corrupt"] == 1 and stats["misses"] == 1
+
+
+def test_save_failure_degrades_to_warning(tmp_path):
+    store = TunedConfigStore(tmp_path)
+    # a key routing the tmp file into a nonexistent subdir makes the
+    # atomic write's open() fail with an OSError (chmod tricks don't
+    # work under root, which CI is)
+    assert store.save("no-such-dir/k", {"knobs": {}}) == 0
+    assert store.stats()["save_errors"] == 1
+    from dist_mnist_tpu.tune.store import _PENDING_TMP
+
+    assert not _PENDING_TMP  # the failure path still cleared its tmp
+
+
+def test_evidence_readback(tmp_path, mesh8):
+    cfg = get_config("mlp_mnist")
+    res = successive_halving(TOY, toy_objective, seed=0, base_budget=8)
+    store = TunedConfigStore(tmp_path)
+    key = tuning_key(cfg, mesh8)
+    store.save(key, make_entry(cfg, mesh8, [res]))
+    entry = store.load(key)
+    assert entry["knobs"] == {"toy": res.winner}
+    ev = entry["evidence"]["toy"]
+    assert ev["metric"] == "toy_cost"
+    assert ev["value"] == res.winner_score
+    assert ev["baseline"] == res.default_score
+    assert ev["bench_stage"] == "none"
+    assert ev["measured_at"] > 0
+    assert entry["backend"] == jax.default_backend()
+    assert entry["jax_version"] == jax.__version__
+    # the key fields ride along, human-readable, for store forensics
+    assert entry["fields"]["kind"] == "'tuned'"
+
+
+# -- apply paths ---------------------------------------------------------------
+
+
+def _apply_poisoned(cfg, mesh, *, bucket_mb=0.5, store_dir=None, mode="auto",
+                    protect=(), subsystem="train", tmp_path=None,
+                    extra_knobs=()):
+    """Seed a store with a hand-written winner entry and apply it."""
+    import tempfile
+
+    root = store_dir or tempfile.mkdtemp(prefix="tuned-store-")
+    store = TunedConfigStore(root)
+    knobs = {"overlap_bucket_mb": bucket_mb, "prefetch_depth": 4,
+             "serve_max_batch": 32, "serve_seq_buckets": "auto",
+             "scan_chunk": 100, **dict(extra_knobs)}
+    store.save(tuning_key(cfg, mesh), {
+        "knobs": knobs,
+        "evidence": {"overlap_bucket_mb": {
+            "metric": "exposed_gather_cost_mb", "value": 1.28,
+            "baseline": 1.80, "bench_stage": "overlap",
+            "measured_at": 1700000000.0}},
+    })
+    return apply_tuned(cfg, mesh, mode=mode, store_dir=root,
+                       protect=protect, subsystem=subsystem)
+
+
+def test_apply_tuned_train_hit_applies_and_journals(tmp_path, mesh8):
+    cfg = get_config("mlp_mnist")
+    prev = events.set_journal(events.RunJournal(tmp_path / "j.jsonl"))
+    try:
+        tuned_cfg, runtime = _apply_poisoned(cfg, mesh8)
+    finally:
+        events.set_journal(prev).close()
+    assert tuned_cfg.overlap_bucket_mb == 0.5
+    assert runtime == {"prefetch_depth": 4}  # serve knobs: wrong subsystem
+    # scan_chunk is auto_apply=False: stored but never applied
+    recs = [json.loads(line) for line in
+            (tmp_path / "j.jsonl").read_text().splitlines()
+            if '"tuning/applied"' in line]
+    by_knob = {r["knob"]: r for r in recs}
+    assert set(by_knob) == {"overlap_bucket_mb", "prefetch_depth"}
+    ev = by_knob["overlap_bucket_mb"]
+    # the acceptance-criteria evidence fields, replayed from the store
+    assert ev["value"] == 0.5
+    assert ev["metric"] == "exposed_gather_cost_mb"
+    assert ev["measured"] == 1.28 and ev["baseline"] == 1.80
+    assert ev["bench_stage"] == "overlap"
+    assert ev["measured_at"] == 1700000000.0
+
+
+def test_apply_tuned_serve_subsystem(mesh8):
+    cfg = get_config("mlp_mnist")
+    tuned_cfg, runtime = _apply_poisoned(cfg, mesh8, subsystem="serve")
+    assert tuned_cfg.overlap_bucket_mb == cfg.overlap_bucket_mb  # train knob
+    assert runtime == {"serve_max_batch": 32, "serve_seq_buckets": "auto"}
+
+
+def test_apply_tuned_protect_pins_explicit_flags(mesh8):
+    cfg = get_config("mlp_mnist")
+    tuned_cfg, runtime = _apply_poisoned(
+        cfg, mesh8, protect=("overlap_bucket_mb", "prefetch_depth"))
+    assert tuned_cfg.overlap_bucket_mb == cfg.overlap_bucket_mb
+    assert runtime == {}
+
+
+def test_apply_tuned_miss_emits_stale_key(tmp_path, mesh8):
+    cfg = get_config("mlp_mnist")
+    prev = events.set_journal(events.RunJournal(tmp_path / "j.jsonl"))
+    try:
+        out_cfg, runtime = apply_tuned(cfg, mesh8, mode="auto",
+                                       store_dir=str(tmp_path / "empty"))
+    finally:
+        events.set_journal(prev).close()
+    assert out_cfg is cfg and runtime == {}
+    recs = [json.loads(line) for line in
+            (tmp_path / "j.jsonl").read_text().splitlines()]
+    assert [r["event"] for r in recs] == ["tuning/stale_key"]
+    assert recs[0]["mode"] == "auto" and recs[0]["subsystem"] == "train"
+
+
+def test_apply_tuned_require_miss_raises(tmp_path, mesh8):
+    cfg = get_config("mlp_mnist")
+    with pytest.raises(TunedConfigMissError, match="never tuned"):
+        apply_tuned(cfg, mesh8, mode="require",
+                    store_dir=str(tmp_path / "empty"))
+    with pytest.raises(TunedConfigMissError, match="no tuned-config store"):
+        apply_tuned(cfg, mesh8, mode="require", store_dir=None)
+
+
+def test_run_config_tuned_require_refuses_on_miss(tmp_path):
+    cfg = get_config("mlp_mnist", train_steps=10, eval_every=0)
+    with pytest.raises(TunedConfigMissError):
+        run_config(cfg, data_dir=str(tmp_path / "data"), tuned="require",
+                   tuned_dir=str(tmp_path / "empty"))
+
+
+def test_run_config_tuned_off_bit_identical(tmp_path, monkeypatch):
+    """--tuned=off must be bit-identical to the pre-tuner driver even
+    with a poisoned store injected via the environment: the off path
+    never consults (or imports) the tuner."""
+    cfg = get_config("mlp_mnist", train_steps=20, eval_every=0)
+    data = str(tmp_path / "data")
+    monkeypatch.delenv("DIST_MNIST_TPU_TUNED_DIR", raising=False)
+    state_ref, final_ref, _ = run_config(cfg, data_dir=data)
+    # seed a store entry FOR THIS GEOMETRY that would change the run
+    from dist_mnist_tpu.cluster.mesh import make_mesh
+
+    store = TunedConfigStore(tmp_path / "store")
+    store.save(tuning_key(cfg, make_mesh(cfg.mesh)),
+               {"knobs": {"overlap_bucket_mb": 0.5, "prefetch_depth": 8}})
+    monkeypatch.setenv("DIST_MNIST_TPU_TUNED_DIR", str(tmp_path / "store"))
+    state_off, final_off, _ = run_config(cfg, data_dir=data, tuned="off")
+    assert final_off["loss"] == final_ref["loss"]
+    for a, b in zip(jax.tree.leaves(state_ref.params),
+                    jax.tree.leaves(state_off.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- rendering -----------------------------------------------------------------
+
+
+def test_tail_run_renders_tuning_applied():
+    sys.path.insert(0, "scripts")
+    try:
+        from tail_run import format_record
+    finally:
+        sys.path.pop(0)
+    line = format_record({
+        "seq": 7, "ts": 1700000000.0, "pid": 1, "gen": 0,
+        "event": "tuning/applied", "knob": "overlap_bucket_mb",
+        "value": 0.5, "metric": "exposed_gather_cost_mb",
+        "measured": 1.28, "baseline": 1.80, "bench_stage": "overlap",
+    })
+    assert "overlap_bucket_mb=0.5" in line
+    assert "exposed_gather_cost_mb" in line
+    assert "1.28" in line and "vs default 1.80" in line
