@@ -74,6 +74,13 @@ TUNER_RUNTIME_ONLY: dict[str, str] = {
                        " grid cell compiles under its own zoo executable"
                        " key (serve/zoo.py), never the train-step key",
     "serve_seq_buckets": "same grid: per-bucket zoo keys absorb it",
+    "snapshot_window": "host-side write-behind ring depth"
+                       " (checkpoint/snapshot.py); the traced step never"
+                       " sees the snapshot queue",
+    "moe_capacity_factor": "serve-only knob: the zoo engine folds the"
+                           " live factor into every per-cell executable"
+                           " key (serve/engine.py _key/_store_key), so"
+                           " it never touches the train-step key",
 }
 
 

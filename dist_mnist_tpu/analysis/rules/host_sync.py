@@ -40,6 +40,7 @@ HOT_PATH_TARGETS = (
     "dist_mnist_tpu/hooks/builtin.py",
     "dist_mnist_tpu/parallel/overlap.py",
     "dist_mnist_tpu/serve/zoo.py",
+    "dist_mnist_tpu/serve/autoscale.py",
     "dist_mnist_tpu/ops/quant.py",
     "dist_mnist_tpu/serve/engine.py",
     "dist_mnist_tpu/serve/loader.py",

@@ -87,7 +87,26 @@ flags.DEFINE_boolean("watch", False,
                      "poll <checkpoint_dir>/commits and hot-swap the fleet "
                      "to each newly committed step (zero-downtime roll)")
 flags.DEFINE_float("watch_interval_s", 2.0, "commit-marker poll cadence")
+# -- autoscaling (serve/autoscale.py; requires --inprocess) --------------------
+flags.DEFINE_boolean("autoscale", False,
+                     "run an Autoscaler control loop over the fleet: "
+                     "traffic-driven replica add/remove between "
+                     "--min_replicas and --max_replicas (in-process fleets "
+                     "only; new replicas warm-start from the shared compile "
+                     "cache)")
+flags.DEFINE_integer("min_replicas", 1, "autoscaler floor")
+flags.DEFINE_integer("max_replicas", 8, "autoscaler ceiling")
+flags.DEFINE_float("slo_p99_ms", 500.0,
+                   "latency_sensitive p99 SLO the autoscaler defends")
+flags.DEFINE_float("autoscale_interval_s", 0.25, "control-loop tick cadence")
 # -- load generation ----------------------------------------------------------
+flags.DEFINE_string("trace", None,
+                    "trace-driven open-loop arrivals instead of the "
+                    "closed-loop loadgen: diurnal | burst | flash_crowd")
+flags.DEFINE_float("trace_duration_s", 20.0, "trace length (trace seconds)")
+flags.DEFINE_float("trace_base_rps", 10.0, "trace baseline request rate")
+flags.DEFINE_float("trace_peak_mult", 10.0,
+                   "peak rate as a multiple of --trace_base_rps")
 flags.DEFINE_integer("requests", 512, "loadgen request count")
 flags.DEFINE_integer("concurrency", 64, "loadgen in-flight window")
 flags.DEFINE_integer("seed", 0, "loadgen input/class seed")
@@ -118,63 +137,92 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn_replicas(n: int):
-    """Spawn n `cli/serve.py --serve_forever` children and wait until each
-    /healthz reports serving. Returns (procs, HttpReplicas)."""
+def _spawn_one_replica(i: int):
+    """Spawn ONE `cli/serve.py --serve_forever` child (registered in
+    `_LIVE_REPLICA_PROCS` immediately, before any wait, so a crash between
+    spawn and admission still leaves the proc visible to the leak check).
+    Returns (proc, url) without waiting for /healthz."""
     import os
     import subprocess
     import sys
+
+    from dist_mnist_tpu.obs import events as events_mod
+
+    port = _free_port()
+    cmd = [
+        sys.executable, "-m", "dist_mnist_tpu.cli.serve",
+        "--serve_forever", f"--config={FLAGS.config}",
+        f"--metrics_port={port}", f"--replica_id={i}",
+        f"--max_batch={FLAGS.max_batch}",
+        f"--max_wait_ms={FLAGS.max_wait_ms}",
+        f"--queue_depth={FLAGS.queue_depth}",
+    ]
+    if FLAGS.checkpoint_dir:
+        cmd.append(f"--checkpoint_dir={FLAGS.checkpoint_dir}")
+    if FLAGS.step is not None:
+        cmd.append(f"--step={FLAGS.step}")
+    if FLAGS.platform:
+        cmd.append(f"--platform={FLAGS.platform}")
+    if FLAGS.host_device_count:
+        cmd.append(f"--host_device_count={FLAGS.host_device_count}")
+    if FLAGS.compile_cache_dir:
+        cmd.append(f"--compile_cache_dir={FLAGS.compile_cache_dir}")
+    if FLAGS.seq_buckets:
+        cmd.append(f"--seq_buckets={FLAGS.seq_buckets}")
+    if FLAGS.moe_capacity_factor:
+        cmd.append(f"--moe_capacity_factor={FLAGS.moe_capacity_factor}")
+    if FLAGS.serve_memory_budget_mb:
+        cmd.append(
+            f"--serve_memory_budget_mb={FLAGS.serve_memory_budget_mb}")
+    if FLAGS.serve_rules:
+        cmd.append(f"--serve_rules={FLAGS.serve_rules}")
+    if FLAGS.quant:
+        cmd.append(f"--quant={FLAGS.quant}")
+    if FLAGS.fault_plan:
+        cmd.append(f"--fault_plan={FLAGS.fault_plan}")
+    if FLAGS.mesh:
+        cmd.append(f"--mesh={FLAGS.mesh}")
+    env = dict(os.environ)
+    env[events_mod.ENV_HOST_ID] = str(i)
+    if FLAGS.journal:
+        env[events_mod.ENV_JOURNAL] = FLAGS.journal
+    proc = subprocess.Popen(cmd, env=env)
+    _LIVE_REPLICA_PROCS.append(proc)
+    url = f"http://127.0.0.1:{port}"
+    log.info("spawned replica %d (pid %d) on %s", i, proc.pid, url)
+    return proc, url
+
+
+def _reap_replica_proc(proc):
+    """Terminate a spawned replica child and delist it from the leak
+    registry — the single teardown path whether the replica retires at
+    shutdown or mid-run (membership churn)."""
+    import signal
+
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=30)
+    except Exception:  # noqa: BLE001
+        proc.kill()
+        proc.wait(timeout=5)
+    if proc in _LIVE_REPLICA_PROCS:
+        _LIVE_REPLICA_PROCS.remove(proc)
+
+
+def _spawn_replicas(n: int):
+    """Spawn n `cli/serve.py --serve_forever` children and wait until each
+    /healthz reports serving. Returns (procs, HttpReplicas)."""
     import time
     import urllib.request
 
-    from dist_mnist_tpu.obs import events as events_mod
     from dist_mnist_tpu.serve import HttpReplica
 
     procs, urls = [], {}
     for i in range(n):
-        port = _free_port()
-        cmd = [
-            sys.executable, "-m", "dist_mnist_tpu.cli.serve",
-            "--serve_forever", f"--config={FLAGS.config}",
-            f"--metrics_port={port}", f"--replica_id={i}",
-            f"--max_batch={FLAGS.max_batch}",
-            f"--max_wait_ms={FLAGS.max_wait_ms}",
-            f"--queue_depth={FLAGS.queue_depth}",
-        ]
-        if FLAGS.checkpoint_dir:
-            cmd.append(f"--checkpoint_dir={FLAGS.checkpoint_dir}")
-        if FLAGS.step is not None:
-            cmd.append(f"--step={FLAGS.step}")
-        if FLAGS.platform:
-            cmd.append(f"--platform={FLAGS.platform}")
-        if FLAGS.host_device_count:
-            cmd.append(f"--host_device_count={FLAGS.host_device_count}")
-        if FLAGS.compile_cache_dir:
-            cmd.append(f"--compile_cache_dir={FLAGS.compile_cache_dir}")
-        if FLAGS.seq_buckets:
-            cmd.append(f"--seq_buckets={FLAGS.seq_buckets}")
-        if FLAGS.moe_capacity_factor:
-            cmd.append(f"--moe_capacity_factor={FLAGS.moe_capacity_factor}")
-        if FLAGS.serve_memory_budget_mb:
-            cmd.append(
-                f"--serve_memory_budget_mb={FLAGS.serve_memory_budget_mb}")
-        if FLAGS.serve_rules:
-            cmd.append(f"--serve_rules={FLAGS.serve_rules}")
-        if FLAGS.quant:
-            cmd.append(f"--quant={FLAGS.quant}")
-        if FLAGS.fault_plan:
-            cmd.append(f"--fault_plan={FLAGS.fault_plan}")
-        if FLAGS.mesh:
-            cmd.append(f"--mesh={FLAGS.mesh}")
-        env = dict(os.environ)
-        env[events_mod.ENV_HOST_ID] = str(i)
-        if FLAGS.journal:
-            env[events_mod.ENV_JOURNAL] = FLAGS.journal
-        proc = subprocess.Popen(cmd, env=env)
+        proc, url = _spawn_one_replica(i)
         procs.append(proc)
-        _LIVE_REPLICA_PROCS.append(proc)
-        urls[i] = f"http://127.0.0.1:{port}"
-        log.info("spawned replica %d (pid %d) on %s", i, proc.pid, urls[i])
+        urls[i] = url
 
     deadline = time.monotonic() + 180.0  # cold jax import + prewarm compiles
     for i, proc in enumerate(procs):
@@ -239,25 +287,34 @@ def _build_inprocess_replicas(n: int):
 
         plan = FaultPlan.from_spec(FLAGS.fault_plan)
 
-    def make_server_factory(replica_id: int):
+    def make_server_factory(replica_id: int, startup=None):
+        # `startup` is an optional StartupClock: when the autoscaler spawns
+        # a replica it wants load-vs-compile attribution, so the engine/
+        # weights build lands in the "restore" bucket and the prewarm (a
+        # shared-cache rewarm — near-zero when warm) in "compile"
+        from contextlib import nullcontext
+
         def make_server():
-            engine = build_zoo_engine(
-                bundle, mesh, model_name=cfg.model,
-                max_bucket=max(FLAGS.max_batch, 1),
-                seq_buckets=FLAGS.seq_buckets or None,
-                moe_capacity_factor=FLAGS.moe_capacity_factor or None,
-                memory_budget_mb=FLAGS.serve_memory_budget_mb or None,
-                cache=shared_cache,
-            )
-            if plan is not None:
-                engine = plan.wrap_engine(engine, replica_id=replica_id)
-            return InferenceServer(
-                engine,
-                ServeConfig(max_batch=FLAGS.max_batch,
-                            max_wait_ms=FLAGS.max_wait_ms,
-                            queue_depth=FLAGS.queue_depth),
-                health=HealthState(),
-            ).start()
+            with (startup.phase("restore") if startup else nullcontext()):
+                engine = build_zoo_engine(
+                    bundle, mesh, model_name=cfg.model,
+                    max_bucket=max(FLAGS.max_batch, 1),
+                    seq_buckets=FLAGS.seq_buckets or None,
+                    moe_capacity_factor=FLAGS.moe_capacity_factor or None,
+                    memory_budget_mb=FLAGS.serve_memory_budget_mb or None,
+                    cache=shared_cache,
+                )
+                if plan is not None:
+                    engine = plan.wrap_engine(engine, replica_id=replica_id)
+                server = InferenceServer(
+                    engine,
+                    ServeConfig(max_batch=FLAGS.max_batch,
+                                max_wait_ms=FLAGS.max_wait_ms,
+                                queue_depth=FLAGS.queue_depth),
+                    health=HealthState(),
+                )
+            with (startup.phase("compile") if startup else nullcontext()):
+                return server.start()
 
         return make_server
 
@@ -273,13 +330,17 @@ def _build_inprocess_replicas(n: int):
             raise FileNotFoundError(f"no committed checkpoint at step {step}")
         return new.params, new.model_state
 
-    replicas = [
-        InProcessReplica(i, make_server_factory(i),
-                         load_weights=load_weights if FLAGS.checkpoint_dir
-                         else None).start()
-        for i in range(n)
-    ]
-    return bundle, replicas
+    def make_replica(replica_id: int, startup=None):
+        """Build-and-start one replica over the SAME bundle/mesh/shared
+        cache — the autoscaler's spawn seam (cold replicas rewarm from the
+        fleet's compile cache instead of compiling)."""
+        return InProcessReplica(
+            replica_id, make_server_factory(replica_id, startup),
+            load_weights=load_weights if FLAGS.checkpoint_dir else None,
+        ).start()
+
+    replicas = [make_replica(i) for i in range(n)]
+    return bundle, replicas, make_replica, shared_cache
 
 
 def main(argv):
@@ -291,7 +352,6 @@ def main(argv):
     logging.getLogger("absl").setLevel(logging.WARNING)
 
     import os
-    import signal
 
     from dist_mnist_tpu.obs import (
         FleetScraper,
@@ -317,15 +377,24 @@ def main(argv):
     if journal is not None:
         events_mod.set_journal(journal)
 
+    if FLAGS.autoscale and not FLAGS.inprocess:
+        raise app.UsageError("--autoscale requires --inprocess (the spawn "
+                             "seam shares one compile cache and mesh)")
+
     procs: list = []
     scraper = None
     exporter = None
     watcher = None
     router = None
+    autoscaler = None
     replicas: list = []
+    make_replica = None
+    shared_cache = None
     try:
         if FLAGS.inprocess:
-            bundle, replicas = _build_inprocess_replicas(FLAGS.replicas)
+            n0 = FLAGS.min_replicas if FLAGS.autoscale else FLAGS.replicas
+            bundle, replicas, make_replica, shared_cache = (
+                _build_inprocess_replicas(n0))
             image_shape = bundle.image_shape
             initial_step = bundle.step
         else:
@@ -368,6 +437,38 @@ def main(argv):
         ).start()
         health.set("serving")
 
+        if FLAGS.autoscale:
+            from dist_mnist_tpu.serve import (
+                Autoscaler,
+                FleetSignalSource,
+                ScalePolicy,
+            )
+
+            def _spawn(replica_id, startup):
+                # scaled-up replicas land in `replicas` so the finally
+                # block below owns their teardown like the seed fleet's
+                replica = make_replica(replica_id, startup)
+                replicas.append(replica)
+                return replica
+
+            def _reap(replica):
+                replica.close()
+                if replica in replicas:
+                    replicas.remove(replica)
+
+            autoscaler = Autoscaler(
+                router,
+                FleetSignalSource(router, scraper=scraper),
+                _spawn,
+                reap=_reap,
+                policy=ScalePolicy(min_replicas=FLAGS.min_replicas,
+                                   max_replicas=FLAGS.max_replicas,
+                                   slo_p99_ms=FLAGS.slo_p99_ms),
+                interval_s=FLAGS.autoscale_interval_s,
+                registry=registry,
+                cache=shared_cache,
+            ).start()
+
         if FLAGS.watch:
             if not FLAGS.checkpoint_dir:
                 raise app.UsageError("--watch requires --checkpoint_dir")
@@ -377,16 +478,52 @@ def main(argv):
                 initial_step=initial_step,
             ).start()
 
-        summary = run_fleet_loadgen(
-            router,
-            n_requests=FLAGS.requests,
-            concurrency=FLAGS.concurrency,
-            image_shape=image_shape,
-            seed=FLAGS.seed,
-            ls_fraction=FLAGS.ls_fraction,
-            ls_deadline_ms=FLAGS.ls_deadline_ms or None,
-            be_deadline_ms=FLAGS.be_deadline_ms or None,
-        )
+        if FLAGS.trace:
+            from dist_mnist_tpu.serve import (
+                burst_trace,
+                diurnal_trace,
+                flash_crowd_trace,
+                run_trace_loadgen,
+            )
+
+            dur, base = FLAGS.trace_duration_s, FLAGS.trace_base_rps
+            peak = base * FLAGS.trace_peak_mult
+            if FLAGS.trace == "diurnal":
+                arrivals = diurnal_trace(duration_s=dur, base_rps=base,
+                                         peak_rps=peak, seed=FLAGS.seed)
+            elif FLAGS.trace == "burst":
+                arrivals = burst_trace(
+                    duration_s=dur, base_rps=base, burst_rps=peak,
+                    burst_every_s=dur / 4, burst_len_s=dur / 16,
+                    seed=FLAGS.seed)
+            elif FLAGS.trace == "flash_crowd":
+                arrivals = flash_crowd_trace(
+                    duration_s=dur, base_rps=base, spike_at_s=dur * 0.3,
+                    spike_len_s=dur * 0.2, spike_mult=FLAGS.trace_peak_mult,
+                    seed=FLAGS.seed)
+            else:
+                raise app.UsageError(f"unknown --trace {FLAGS.trace!r}")
+            summary = run_trace_loadgen(
+                router,
+                arrivals=arrivals,
+                image_shape=image_shape,
+                seed=FLAGS.seed,
+                ls_fraction=FLAGS.ls_fraction,
+                ls_deadline_ms=FLAGS.ls_deadline_ms or None,
+                be_deadline_ms=FLAGS.be_deadline_ms or None,
+            )
+            summary["trace"]["kind"] = FLAGS.trace
+        else:
+            summary = run_fleet_loadgen(
+                router,
+                n_requests=FLAGS.requests,
+                concurrency=FLAGS.concurrency,
+                image_shape=image_shape,
+                seed=FLAGS.seed,
+                ls_fraction=FLAGS.ls_fraction,
+                ls_deadline_ms=FLAGS.ls_deadline_ms or None,
+                be_deadline_ms=FLAGS.be_deadline_ms or None,
+            )
         summary["replicas"] = FLAGS.replicas
         summary["inprocess"] = FLAGS.inprocess
         if FLAGS.quant:
@@ -395,7 +532,11 @@ def main(argv):
         if watcher is not None:
             summary["watcher"] = {"polls": watcher.polls,
                                   "rolls": watcher.rolls}
+        if autoscaler is not None:
+            summary["autoscale"] = autoscaler.snapshot()
     finally:
+        if autoscaler is not None:
+            autoscaler.close()
         if watcher is not None:
             watcher.close()
         if router is not None:
@@ -405,17 +546,8 @@ def main(argv):
                 r.close()
             except Exception:  # noqa: BLE001 - best-effort teardown
                 log.warning("replica close failed", exc_info=True)
-        for proc in procs:
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGTERM)
-        for proc in procs:
-            try:
-                proc.wait(timeout=30)
-            except Exception:  # noqa: BLE001
-                proc.kill()
-                proc.wait(timeout=5)
-            if proc in _LIVE_REPLICA_PROCS:
-                _LIVE_REPLICA_PROCS.remove(proc)
+        for proc in list(procs):
+            _reap_replica_proc(proc)
         if scraper is not None:
             scraper.close()
         if exporter is not None:

@@ -20,7 +20,10 @@ Layering (each module depends only on those above it):
     errors.py     failure taxonomy: retryable / terminal / replica-fatal
     router.py     fleet facade: N replicas, tiered shedding, failover,
                   hedging, zero-downtime weight hot-swap
-    loadgen.py    deterministic closed-loop load generators (bench + tests)
+    loadgen.py    deterministic load generators: closed-loop (bench +
+                  tests) and trace-driven open-loop arrival processes
+    autoscale.py  the capacity control loop: FleetSignals -> ScalePolicy
+                  -> Autoscaler actuating Router add/remove_replica
     decode.py     autoregressive decode serving: prefill/decode split,
                   sharded KV cache, continuous batching
 """
@@ -30,6 +33,14 @@ from dist_mnist_tpu.serve.admission import (
     DeadlineExceededError,
     QueueFullError,
     ShuttingDownError,
+)
+from dist_mnist_tpu.serve.autoscale import (
+    Autoscaler,
+    Decision,
+    FleetSignals,
+    FleetSignalSource,
+    PolicyState,
+    ScalePolicy,
 )
 from dist_mnist_tpu.serve.decode import (
     DecodeEngine,
@@ -53,11 +64,15 @@ from dist_mnist_tpu.serve.loader import (
     quantize_for_serving,
 )
 from dist_mnist_tpu.serve.loadgen import (
+    burst_trace,
+    diurnal_trace,
+    flash_crowd_trace,
     make_prompts,
     run_decode_loadgen,
     run_fleet_loadgen,
     run_loadgen,
     run_longctx_loadgen,
+    run_trace_loadgen,
 )
 from dist_mnist_tpu.serve.metrics import DecodeMetrics, ServeMetrics
 from dist_mnist_tpu.serve.router import (
@@ -85,25 +100,31 @@ from dist_mnist_tpu.serve.zoo import (
 __all__ = [
     "AdmissionQueue",
     "AllReplicasDownError",
+    "Autoscaler",
     "BEST_EFFORT",
     "CheckpointWatcher",
     "CompiledModelCache",
     "DECODE_SLO_TARGETS",
     "DeadlineExceededError",
+    "Decision",
     "DecodeEngine",
     "DecodeGrid",
     "DecodeMetrics",
     "DecodeResult",
     "DecodeScheduler",
+    "FleetSignalSource",
+    "FleetSignals",
     "HttpReplica",
     "InProcessReplica",
     "InferenceEngine",
     "InferenceServer",
     "LATENCY_SENSITIVE",
+    "PolicyState",
     "QueueFullError",
     "ReplicaKilledError",
     "Router",
     "RouterConfig",
+    "ScalePolicy",
     "SeqGrid",
     "ServeConfig",
     "ServeMemoryBudgetError",
@@ -112,9 +133,12 @@ __all__ = [
     "ShuttingDownError",
     "build_decode_engine",
     "build_zoo_engine",
+    "burst_trace",
     "classify_failure",
     "default_decode_grid",
     "default_seq_grid",
+    "diurnal_trace",
+    "flash_crowd_trace",
     "init_lm_for_serving",
     "load_for_serving",
     "make_prompts",
@@ -124,5 +148,6 @@ __all__ = [
     "run_fleet_loadgen",
     "run_loadgen",
     "run_longctx_loadgen",
+    "run_trace_loadgen",
     "supports_mask",
 ]

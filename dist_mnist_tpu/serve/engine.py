@@ -491,9 +491,16 @@ class InferenceEngine:
         # the float tag is byte-identical to the historical one
         dtype_key = ("uint8->float32" if not self.quant
                      else f"uint8->float32/w{self.quant}")
-        return (self.model_name, (bucket, h, *self.image_shape[1:]),
-                mesh_key, dtype_key,
-                "dense" if height is None else "masked")
+        key = (self.model_name, (bucket, h, *self.image_shape[1:]),
+               mesh_key, dtype_key,
+               "dense" if height is None else "masked")
+        # the capacity factor is baked into an MoE program's expert-buffer
+        # shapes, so two factors can never share an executable; folded in
+        # only for MoE models so dense keys stay byte-identical
+        cap = getattr(self.model, "moe_capacity_factor", None)
+        if self._moe and cap is not None:
+            key = (*key, ("moe_capacity_factor", cap))
+        return key
 
     def _compile(self, bucket: int, height: int | None = None):
         h = self.image_shape[0] if height is None else height
@@ -559,6 +566,10 @@ class InferenceEngine:
             payload["variant"] = "masked"
         if self._moe:
             payload["moe_outputs"] = "drop_fraction"
+            cap = getattr(self.model, "moe_capacity_factor", None)
+            if cap is not None:
+                # shapes change with the factor — see _key
+                payload["moe_capacity_factor"] = cap
         # conditional for the same reason: float payloads stay byte-for-
         # byte what they were, while an int8 engine's store keys diverge —
         # a warm-start store can never hand an int8 program to a float

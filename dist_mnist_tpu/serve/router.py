@@ -44,6 +44,14 @@ exist (health states, quiesce, the admission error types):
    it in load-not-compile time) -> serve. One replica swaps while the
    rest carry traffic, so the roll drops nothing.
 
+4. **Replica membership as a control variable.** `add_replica` /
+   `remove_replica` let a controller (serve/autoscale.py) grow and
+   shrink the fleet under traffic: admission is gated on a warm-up
+   probe (a ``starting`` view is never routed to), removal drains via
+   the same quiesce machinery the weight roll uses, and every internal
+   walk of the view set snapshots under the lock, so churn is safe
+   against probes, timers, and rolls in flight.
+
 Thread inventory (all named ``Router*`` for the conftest leak-check, all
 joined by `close()`): RouterHealth (probe loop), RouterTimer (retry
 backoff + hedge timers), RouterWatcher (commit-marker poll),
@@ -152,6 +160,8 @@ class RouterMetrics:
         self.replica_downs = 0
         self.replica_ups = 0
         self.replica_drains = 0
+        self.replica_adds = 0
+        self.replica_removes = 0
         self.swaps = 0
         self.swap_failures = 0
         self.latency_ms = {c: StreamingHistogram() for c in REQUEST_CLASSES}
@@ -217,6 +227,8 @@ class RouterMetrics:
                 "replica_downs": self.replica_downs,
                 "replica_ups": self.replica_ups,
                 "replica_drains": self.replica_drains,
+                "replica_adds": self.replica_adds,
+                "replica_removes": self.replica_removes,
                 "swaps": self.swaps,
                 "swap_failures": self.swap_failures,
                 "recovery_ms": [round(v, 3) for v in self.recovery_ms],
@@ -940,6 +952,87 @@ class Router:
         with self._lock:
             return {rid: v.state for rid, v in self._views.items()}
 
+    # -- replica membership (the autoscaler's seam) ---------------------------
+    # The replica set is NOT immutable after construction: serve/autoscale.py
+    # adds and removes replicas while traffic flows. Everything that walks
+    # the views already snapshots under `_lock` (`_pick`, `_probe_all`,
+    # `backlog_fraction`, `_export_gauges`), scheduler timers capture _View
+    # objects (alive after removal, so late attempt callbacks settle
+    # harmlessly), and `roll_weights` re-looks ids up with `.get` — so
+    # membership churn needs no further coordination than these two methods.
+
+    def add_replica(self, replica, *, wait_serving_s: float = 30.0,
+                    probe_interval_s: float = 0.05) -> bool:
+        """Admit a new replica behind a warm-up gate.
+
+        The view enters as ``starting`` — `_pick` never routes to it — and
+        is promoted to ``serving`` only once the replica's own probe
+        reports healthy. Returns True on admission; on a warm-up timeout
+        the view is withdrawn and False returned (the caller still owns
+        the replica and should reap it). Raises ValueError on a duplicate
+        id and ShuttingDownError on a closed router."""
+        if self._closed or not self._started:
+            raise ShuttingDownError("router is not running")
+        with self._lock:
+            if replica.id in self._views:
+                raise ValueError(f"duplicate replica id {replica.id}")
+            view = _View(replica=replica)
+            self._views[replica.id] = view
+        self.metrics.bump("replica_adds")
+        deadline = time.monotonic() + wait_serving_s
+        while time.monotonic() < deadline and not self._closed:
+            with self._lock:
+                already = view.state == "serving"
+            if already:
+                break  # the health loop promoted it between our probes
+            try:
+                snap = replica.probe()
+            except Exception:  # noqa: BLE001 — not warm yet
+                snap = {"healthy": False}
+            if snap.get("healthy"):
+                with self._lock:
+                    if view.state == "starting":
+                        view.state = "serving"
+                break
+            time.sleep(probe_interval_s)
+        with self._lock:
+            admitted = view.state == "serving"
+            if not admitted:
+                self._views.pop(replica.id, None)
+        if admitted:
+            self.metrics.bump("replica_ups")
+            events.emit("replica_up", replica=replica.id,
+                        generation=getattr(replica, "generation", 0))
+        return admitted
+
+    def remove_replica(self, rid, *, quiesce_timeout_s: float = 30.0):
+        """Drain a replica out of the fleet and return its handle.
+
+        Marks it ``draining`` (no new routing; in-flight requests finish
+        via the existing quiesce machinery), quiesces, then drops the view
+        and any pending-recovery bookkeeping. The router never owned the
+        replica's lifecycle, so the HANDLE is returned for the caller to
+        close/reap. Raises KeyError for an unknown id."""
+        with self._lock:
+            view = self._views.get(rid)
+            if view is None:
+                raise KeyError(f"no replica {rid!r} in the fleet")
+            view.state = "draining"
+        self.metrics.bump("replica_drains")
+        events.emit("replica_drain", replica=rid)
+        try:
+            drained = view.replica.quiesce(quiesce_timeout_s)
+        except Exception:  # noqa: BLE001 — a dead replica still gets removed
+            drained = False
+        if not drained:
+            log.warning("replica %s did not quiesce within %.1fs; removing "
+                        "anyway", rid, quiesce_timeout_s)
+        with self._lock:
+            self._views.pop(rid, None)
+            self._pending_recovery.pop(rid, None)
+        self.metrics.bump("replica_removes")
+        return view.replica
+
     # -- weight hot-swap -----------------------------------------------------
     def roll_weights(self, step: int) -> dict:
         """Roll `step`'s weights across the fleet, one replica at a time:
@@ -952,7 +1045,9 @@ class Router:
             swapped: list = []
             failed: list = []
             for rid in sorted(self._views):
-                view = self._views[rid]
+                view = self._views.get(rid)
+                if view is None:
+                    continue  # removed mid-roll (autoscale scale-down)
                 with self._lock:
                     if view.state != "serving":
                         failed.append({"replica": rid,

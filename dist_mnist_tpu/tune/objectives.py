@@ -161,6 +161,53 @@ def serve_grid_objective(image_shape=(28, 28, 1), patch: int = 4):
     return objective
 
 
+# ------------------------------------------------------ moe_capacity_factor
+
+#: FLOP toll per unit of extra capacity factor: a bigger expert buffer
+#: executes proportionally more padded expert math whether or not the
+#: slots are filled (models/moe.py fixed-shape dispatch)
+CAPACITY_TOLL = 0.05
+
+
+def moe_capacity_objective(*, n_experts: int = 8, tokens: int = 256,
+                           alpha: float = 0.3):
+    """Objective for `moe_capacity_factor`: the deterministic
+    drop-fraction cost of a capacity factor under skewed routing. Each
+    trial draws seeded Dirichlet(alpha) routing distributions (alpha < 1:
+    the hot-expert skew that makes capacity a real trade), multinomial
+    token loads per expert, and drops every token over the
+    ceil(factor * tokens / n_experts) buffer — exactly the fixed-shape
+    dispatch models/moe.py executes. Score = mean drop fraction +
+    CAPACITY_TOLL * (factor - 1): more capacity buys fewer drops with
+    strictly more padded expert FLOPs, and the knee is the winner. Pure
+    seeded arithmetic: deterministic on every backend."""
+    import math
+
+    def objective(candidate, *, budget: int, seed: int):
+        factor = float(candidate)  # lint: ok[host-sync] host-side candidate arithmetic, no device value involved
+        rng = np.random.default_rng(seed)
+        capacity = math.ceil(factor * tokens / n_experts)
+        dropped = 0
+        for _ in range(budget):
+            probs = rng.dirichlet(np.full(n_experts, alpha))
+            loads = rng.multinomial(tokens, probs)
+            dropped += int(np.maximum(loads - capacity, 0).sum())
+        drop_fraction = dropped / (budget * tokens)
+        score = drop_fraction + CAPACITY_TOLL * (factor - 1.0)
+        return score, {
+            "drop_fraction": round(drop_fraction, 4),
+            "capacity_per_expert": capacity,
+            "capacity_toll": CAPACITY_TOLL,
+            "n_experts": n_experts,
+            "tokens": tokens,
+            "routing_alpha": alpha,
+            "batches": budget,
+            "seed": seed,
+        }
+
+    return objective
+
+
 # ----------------------------------------------------- timed, offline-only
 
 def input_feed_objective(mesh=None, *, batch: int = 512,
@@ -272,6 +319,64 @@ def scan_chunk_objective(mesh=None, *, model_name: str = "lenet5",
     return objective
 
 
+def snapshot_window_objective(*, ckpt_dir: str | None = None):
+    """Objective for `snapshot_window` (timed; offline): the mean
+    caller-visible `save()` wall (ms) of a burst of back-to-back
+    snapshots through an AsyncSnapshotter at the candidate window depth,
+    against a real CheckpointManager — exactly the fork + admission
+    stall the train loop pays (checkpoint/snapshot.py save_stall_s
+    attribution, asked per window)."""
+    import dataclasses
+    import shutil
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from dist_mnist_tpu import optim
+    from dist_mnist_tpu.checkpoint.manager import CheckpointManager
+    from dist_mnist_tpu.checkpoint.snapshot import AsyncSnapshotter
+    from dist_mnist_tpu.models import get_model
+    from dist_mnist_tpu.train import create_train_state
+
+    model = get_model("mlp")
+    state0 = create_train_state(model, optim.adam(1e-3),
+                                jax.random.PRNGKey(0),
+                                jnp.zeros((1, 28, 28, 1), jnp.float32))
+
+    def objective(candidate, *, budget: int, seed: int):
+        window = int(candidate)
+        tmp = ckpt_dir or tempfile.mkdtemp(prefix="tune_snapwin_")
+        mgr = CheckpointManager(tmp, async_save=False, max_to_keep=2)
+        snap = AsyncSnapshotter(mgr, window=window)
+        try:
+            walls = []
+            for i in range(budget):
+                state = dataclasses.replace(
+                    state0, step=jnp.asarray(seed * 10_000 + i, jnp.int32))
+                t0 = time.perf_counter()
+                snap.save(state)
+                walls.append((time.perf_counter() - t0) * 1e3)
+            snap.wait()
+        finally:
+            snap.close()
+            mgr.close()
+            if ckpt_dir is None:
+                shutil.rmtree(tmp, ignore_errors=True)
+        ms = sum(walls) / max(len(walls), 1)
+        return ms, {
+            "window": window,
+            "saves": budget,
+            "save_stall_s": round(snap.save_stall_s, 4),
+            "dropped": snap.dropped,
+            "max_save_call_ms": round(max(walls, default=0.0), 3),
+            "seed": seed,
+        }
+
+    return objective
+
+
 def build_objective(name: str, *, mesh=None, model: str = "lenet5",
                     batch: int = 200, data_dir: str = "/tmp/mnist-data"):
     """Objective factory by knob name (the cli/tune.py dispatch)."""
@@ -279,6 +384,10 @@ def build_objective(name: str, *, mesh=None, model: str = "lenet5",
         return overlap_cost_objective(mesh, data_dir=data_dir)
     if name == "serve_grid":
         return serve_grid_objective()
+    if name == "moe_capacity_factor":
+        return moe_capacity_objective()
+    if name == "snapshot_window":
+        return snapshot_window_objective()
     if name == "prefetch_depth":
         return input_feed_objective(mesh, data_dir=data_dir)
     if name == "scan_chunk":

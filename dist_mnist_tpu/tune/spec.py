@@ -31,7 +31,7 @@ class TunableSpec:
     """One knob's declared search space and application contract."""
 
     name: str                  # catalog key; also the stored knob name
-    subsystem: str             # overlap | input | serve | headline
+    subsystem: str             # overlap | input | serve | checkpoint | headline
     candidates: tuple          # the ladder successive halving prunes
     default: Candidate         # the stock default the winner must beat
     metric: str                # objective name recorded in the evidence
@@ -133,6 +133,52 @@ KNOBS: dict[str, TunableSpec] = {
             "host side of the feed, the traced program is identical at "
             "every depth, so it is allowlisted out of the compile key "
             "(analysis/rules/cache_key.py TUNER_RUNTIME_ONLY)."),
+    ),
+    "snapshot_window": TunableSpec(
+        name="snapshot_window",
+        subsystem="checkpoint",
+        candidates=(1, 2, 4, 8),
+        default=1,  # cli/train.py --snapshot_window default
+        metric="save_call_ms",
+        bench_stage="ckpt",
+        target="train_runtime",
+        compile_relevant=False,
+        deterministic=False,  # wall-clock save stalls; offline only
+        doc=(
+            "AsyncSnapshotter write-behind ring depth "
+            "(checkpoint/snapshot.py). The objective times what the TRAIN "
+            "LOOP sees — the caller-visible save() wall per call (fork + "
+            "admission stall) over a burst of back-to-back snapshots "
+            "against a real CheckpointManager: window 1 serializes on "
+            "every in-flight save, deeper windows absorb bursts until "
+            "disk bandwidth is the wall. Runtime-only: the ring is host-"
+            "side write-behind plumbing, the traced step never sees it "
+            "(analysis/rules/cache_key.py TUNER_RUNTIME_ONLY)."),
+    ),
+    "moe_capacity_factor": TunableSpec(
+        name="moe_capacity_factor",
+        subsystem="serve",
+        candidates=(1.0, 1.25, 1.5, 2.0),
+        default=1.25,  # models/vit.py MoE default; cli --moe_capacity_factor
+        metric="moe_drop_cost",
+        bench_stage="serve",
+        target="serve",
+        compile_relevant=False,  # serve-only: folded into the zoo engine's
+        #                          per-cell executable keys, never the
+        #                          train-step key
+        doc=(
+            "inference-time MoE expert capacity factor (serve/zoo.py "
+            "capacity override; models/moe.py buffer sizing). The "
+            "objective is a deterministic drop-fraction cost: seeded "
+            "Dirichlet routing distributions -> multinomial expert "
+            "loads, tokens over each expert's ceil(factor * tokens / "
+            "experts) buffer are dropped, plus a compute toll "
+            "proportional to (factor - 1) for the padded expert math a "
+            "bigger buffer executes. Larger factors buy fewer drops "
+            "with strictly more FLOPs — the knob picks the knee. The "
+            "serve engine folds the live factor into every per-cell "
+            "executable key (serve/engine.py _key/_store_key), so an "
+            "applied winner can never collide with a stale executable."),
     ),
     "scan_chunk": TunableSpec(
         name="scan_chunk",

@@ -59,6 +59,7 @@ run_stage bench_vit_uly_flash 1800 python bench.py --config vit_tiny_cifar_ulyss
 # self-contained bench modes with the same one-JSON-line contract
 run_stage bench_serve     900 python bench.py --serve --deadline 800
 run_stage bench_serve_fleet 900 python bench.py --serve --fleet --deadline 800
+run_stage bench_serve_autoscale 900 python bench.py --serve --autoscale --deadline 800
 run_stage bench_serve_longctx 900 python bench.py --serve --longctx --deadline 800
 run_stage bench_serve_quant 900 python bench.py --serve --quant --deadline 800
 run_stage bench_serve_decode 900 python bench.py --serve --decode --requests 64 --concurrency 16 --deadline 800

@@ -112,6 +112,7 @@ def _no_leaked_prefetch_workers():
                        or t.name.startswith("ServeBatcher")
                        or t.name.startswith("DecodeScheduler")
                        or t.name.startswith("LaunchPump")
+                       or t.name.startswith("Autoscaler")
                        or t.name.startswith("Router"))]
         exporter_mod = sys.modules.get("dist_mnist_tpu.obs.exporter")
         if exporter_mod is not None:

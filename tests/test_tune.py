@@ -28,6 +28,7 @@ from dist_mnist_tpu.tune import (
     tuning_key,
 )
 from dist_mnist_tpu.tune.objectives import (
+    moe_capacity_objective,
     overlap_cost_objective,
     serve_grid_objective,
 )
@@ -124,6 +125,21 @@ def test_serve_grid_objective_seeded_stream():
     assert res.winner != KNOBS["serve_grid"].default
 
 
+def test_moe_capacity_objective_deterministic_and_monotone():
+    objective = moe_capacity_objective()
+    s1, extra = objective(1.25, budget=32, seed=0)
+    s2, _ = objective(1.25, budget=32, seed=0)
+    assert s1 == s2  # seeded Dirichlet/multinomial routing: no wall clock
+    assert 0.0 <= extra["drop_fraction"] <= 1.0
+    # a bigger buffer strictly drops fewer tokens (the toll prices it)
+    drops = [objective(f, budget=32, seed=0)[1]["drop_fraction"]
+             for f in KNOBS["moe_capacity_factor"].candidates]
+    assert drops == sorted(drops, reverse=True)
+    res = successive_halving(KNOBS["moe_capacity_factor"], objective,
+                             seed=0, base_budget=32)
+    assert res.strictly_beats_default
+
+
 # -- key semantics -------------------------------------------------------------
 
 
@@ -185,7 +201,8 @@ def test_every_catalog_knob_is_classified():
     (knob_values/knob_names) must agree on the flattened names."""
     flat = set(knob_names())
     assert {"overlap_bucket_mb", "serve_max_batch", "serve_seq_buckets",
-            "prefetch_depth", "scan_chunk"} == flat
+            "prefetch_depth", "scan_chunk", "snapshot_window",
+            "moe_capacity_factor"} == flat
     for spec in KNOBS.values():
         assert set(spec.knob_values(spec.default)) == set(
             spec.fields if spec.fields else (spec.name,))
