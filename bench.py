@@ -629,6 +629,52 @@ def bench_serve_longctx(n_requests: int, concurrency: int) -> int:
     return 0
 
 
+def _decode_forced_agreement(engine, reqs, streams) -> tuple[int, int]:
+    """Teacher-forced next-token agreement: replay a reference engine's
+    token streams through `engine`, forcing every step's input token to
+    the reference token, and count argmax matches. This isolates
+    KV-quantization fidelity per position — a free-running comparison
+    would let one flipped near-tie cascade through the rest of the
+    stream and punish the quantizer for autoregression, not accuracy."""
+    import numpy as np
+
+    rows = engine.grid.rows
+    match = total = 0
+    for at in range(0, len(reqs), engine.max_slots):
+        chunk = list(zip(reqs[at:at + engine.max_slots],
+                         streams[at:at + engine.max_slots]))
+        slots = list(range(len(chunk)))
+        for slot, ((prompt, _), stream) in zip(slots, chunk):
+            if not engine.try_reserve(slot, len(prompt) + len(stream)):
+                raise RuntimeError("KV page pool too small for replay")
+        first = engine.prefill([p for (p, _), _ in chunk], slots)
+        tokens = np.zeros(rows, np.int32)
+        positions = np.zeros(rows, np.int32)
+        live = {}
+        plen = {}
+        for slot, ((prompt, _), stream) in zip(slots, chunk):
+            match += int(first[slot] == stream[0])
+            total += 1
+            plen[slot] = len(prompt)
+            if len(stream) > 1:
+                live[slot] = 1  # index of the next position to predict
+        while live:
+            for slot, i in live.items():
+                tokens[slot] = streams[at + slot][i - 1]
+                positions[slot] = plen[slot] + i - 1
+            nxt = engine.decode(tokens, positions)
+            for slot, i in list(live.items()):
+                match += int(nxt[slot] == streams[at + slot][i])
+                total += 1
+                if i + 1 < len(streams[at + slot]):
+                    live[slot] = i + 1
+                else:
+                    del live[slot]
+        for slot in slots:
+            engine.release_slot(slot)
+    return match, total
+
+
 def bench_serve_decode(n_requests: int, concurrency: int) -> int:
     """Autoregressive decode serving (serve/decode.py): continuous
     batching vs the static-batch baseline, SAME engine weights, SAME
@@ -644,6 +690,26 @@ def bench_serve_decode(n_requests: int, concurrency: int) -> int:
       offered load (the reason continuous batching exists: a request
       arriving mid-batch is admitted at the next step instead of
       waiting for the whole static batch to finish).
+
+    Then the paged + quantized KV trio, at EQUAL worst-case capacity
+    (every engine provisioned for the same long max_seq, driven by the
+    same short-request traffic — the serving regime paging exists for,
+    where the dense stripe pays full-capacity attention every step and
+    the paged engine pays only for live pages):
+
+    - paged-float streams bitwise-identical to the dense twin's (the
+      cache_layout="dense" contract: paging relocates KV, never
+      changes the math),
+    - int8 KV teacher-forced token agreement >= 0.99 vs the float
+      engine (per-position fidelity, cascade-free),
+    - peak resident KV bytes (pinned pages + scratch stripe, the
+      high-water the allocator actually charged) <= 0.35x the dense
+      engine's allocation,
+    - int8 tokens/s strictly above dense and TTFT p99 no worse,
+    - zero hot-path recompiles on all three engines.
+
+    Emits two extra anchored records: `decode_kv_bytes_ratio` and
+    `decode_tokens_per_s` (the int8 engine's per-request throughput).
     """
     import jax
 
@@ -710,6 +776,88 @@ def bench_serve_decode(n_requests: int, concurrency: int) -> int:
                        continuous["ttft_p99_ms"], 2),
                    static_ttft_p99_ms=round(static["ttft_p99_ms"], 2))
         return 1
+
+    # ---- paged + quantized KV trio: equal worst-case capacity ----------
+    # long-capacity engines under short-request traffic; the trio's
+    # geometry is independent of the mode-comparison legs above, whose
+    # defaults (and decode_ttft_p99_ms semantics) are untouched
+    from dist_mnist_tpu.serve.loadgen import make_prompts
+
+    geom = dict(dim=128, heads=8, max_seq=4096, depth=2)
+    traffic = dict(max_prompt=32, max_new=32)
+
+    def run_capacity(**overrides) -> tuple:
+        engine = build_decode_engine(mesh, max_slots=max_slots,
+                                     cache=CompiledModelCache(),
+                                     prompt_buckets=(16, 32),
+                                     **geom, **overrides)
+        engine.prewarm()
+        with DecodeScheduler(engine, mode="continuous") as sched:
+            run_decode_loadgen(sched, n_requests=2 * max_slots,
+                               concurrency=concurrency, seed=1, **traffic)
+            summary = run_decode_loadgen(sched, n_requests=n_requests,
+                                         concurrency=concurrency, seed=0,
+                                         keep_streams=True, **traffic)
+        return summary, engine
+
+    dense_cap, dense_eng = run_capacity()
+    paged_cap, _ = run_capacity(cache_layout="paged", kv_page_tokens=32)
+    int8_cap, int8_eng = run_capacity(cache_layout="paged",
+                                      kv_page_tokens=32, kv_quant="int8")
+    for name, summary in (("dense-cap", dense_cap), ("paged-cap", paged_cap),
+                          ("int8-cap", int8_cap)):
+        if summary["errors"] or summary["ok"] != n_requests:
+            emit_error(metric,
+                       f"{name} leg lost requests: ok={summary['ok']} "
+                       f"errors={summary['errors']} of {n_requests}")
+            return 1
+        if summary["recompiles_during_traffic"]:
+            emit_error(metric,
+                       f"{summary['recompiles_during_traffic']} hot-path "
+                       f"recompile(s) in the {name} leg after prewarm")
+            return 1
+    if paged_cap["streams"] != dense_cap["streams"]:
+        ndiff = sum(a != b for a, b in zip(paged_cap["streams"],
+                                           dense_cap["streams"]))
+        emit_error(metric,
+                   f"paged-float streams differ from the dense twin's "
+                   f"({ndiff}/{n_requests} requests) — paging changed "
+                   "the math, not just the KV layout")
+        return 1
+    # teacher-forced replay of the dense streams through the int8 engine
+    # (bounded: 64 requests is plenty of positions for the gate)
+    n_replay = min(n_requests, 64)
+    reqs = make_prompts(n_replay, max_seq=geom["max_seq"], seed=0,
+                        vocab_size=int8_eng.model.vocab_size, **traffic)
+    agree_hits, agree_total = _decode_forced_agreement(
+        int8_eng, reqs, dense_cap["streams"][:n_replay])
+    agreement = agree_hits / max(1, agree_total)
+    if agreement < 0.99:
+        emit_error(metric,
+                   f"int8 KV teacher-forced agreement {agreement:.4f} "
+                   f"< 0.99 ({agree_hits}/{agree_total} positions)")
+        return 1
+    kv = int8_eng.kv_stats()
+    dense_kv_bytes = dense_eng.kv_stats()["kv_bytes_pinned"]
+    ratio = kv["kv_bytes_peak"] / dense_kv_bytes
+    if ratio > 0.35:
+        emit_error(metric,
+                   f"int8 paged peak resident KV {kv['kv_bytes_peak']} B "
+                   f"is {ratio:.3f}x the dense allocation "
+                   f"{dense_kv_bytes} B (> 0.35x)")
+        return 1
+    if not int8_cap["tokens_per_s_mean"] > dense_cap["tokens_per_s_mean"]:
+        emit_error(metric,
+                   f"int8 paged tokens/s {int8_cap['tokens_per_s_mean']:.2f}"
+                   f" not above dense {dense_cap['tokens_per_s_mean']:.2f}"
+                   " at equal capacity")
+        return 1
+    if int8_cap["ttft_p99_ms"] > dense_cap["ttft_p99_ms"]:
+        emit_error(metric,
+                   f"int8 paged TTFT p99 {int8_cap['ttft_p99_ms']:.2f} ms "
+                   f"worse than dense {dense_cap['ttft_p99_ms']:.2f} ms")
+        return 1
+
     emit({
         "metric": metric,
         "value": round(continuous["ttft_p99_ms"], 2),
@@ -738,6 +886,40 @@ def bench_serve_decode(n_requests: int, concurrency: int) -> int:
             },
             "cache": continuous["cache"],
             **_anchor_fields(metric, continuous["ttft_p99_ms"]),
+        },
+    })
+    emit({
+        "metric": "decode_kv_bytes_ratio",
+        "value": round(ratio, 4),
+        "unit": "ratio",
+        "vs_baseline": 0.0,
+        "extra": {
+            "kv_bytes_peak": kv["kv_bytes_peak"],
+            "dense_kv_bytes": dense_kv_bytes,
+            "kv_pages_total": kv["kv_pages_total"],
+            "page_tokens": kv["page_tokens"],
+            "kv_quant": kv["kv_quant"],
+            "int8_forced_agreement": round(agreement, 4),
+            "paged_float_streams_bitwise": True,
+            **_anchor_fields("decode_kv_bytes_ratio", ratio),
+        },
+    })
+    emit({
+        "metric": "decode_tokens_per_s",
+        "value": round(int8_cap["tokens_per_s_mean"], 2),
+        "unit": "tokens/s/request",
+        "vs_baseline": 0.0,
+        "extra": {
+            "dense_tokens_per_s": round(dense_cap["tokens_per_s_mean"], 2),
+            "paged_float_tokens_per_s": round(
+                paged_cap["tokens_per_s_mean"], 2),
+            "speedup_vs_dense": round(int8_cap["tokens_per_s_mean"]
+                                      / dense_cap["tokens_per_s_mean"], 2),
+            "int8_ttft_p99_ms": round(int8_cap["ttft_p99_ms"], 2),
+            "dense_ttft_p99_ms": round(dense_cap["ttft_p99_ms"], 2),
+            "max_seq": geom["max_seq"],
+            **_anchor_fields("decode_tokens_per_s",
+                             int8_cap["tokens_per_s_mean"]),
         },
     })
     return 0

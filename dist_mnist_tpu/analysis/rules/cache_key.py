@@ -81,6 +81,15 @@ TUNER_RUNTIME_ONLY: dict[str, str] = {
                            " live factor into every per-cell executable"
                            " key (serve/engine.py _key/_store_key), so"
                            " it never touches the train-step key",
+    "kv_page_tokens": "decode-serving only: the decode engine folds the"
+                      " live page size into every per-cell executable key"
+                      " (serve/decode.py _layout_key -> _key/_store_key),"
+                      " never the train-step key",
+    "decode_admit_buckets": "decode-serving only: each admit bucket IS a"
+                            " ('prefill', n, s) cell in the decode grid,"
+                            " compiled under its own executable key"
+                            " (serve/decode.py _key); the train-step key"
+                            " is never involved",
 }
 
 

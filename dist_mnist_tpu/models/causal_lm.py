@@ -25,6 +25,36 @@ write included — runs under `compat_shard_map` with heads sharded, so
 each device owns its head slice of the KV cache and updates it locally
 (no collectives: attention is head-parallel, the out-projection happens
 on the gathered activations outside the shard_map).
+
+Cache layouts (``cache_layout``, PR 20): ``"dense"`` is the original
+``[depth, slot, max_seq, heads, head_dim]`` stripe-per-slot buffer and
+stays byte-for-byte the pre-paging code path. ``"paged"`` replaces it
+with a page POOL ``[depth, pages, page_tokens, heads, head_dim]`` plus a
+caller-owned page table ``[rows, n]`` (int32 pool indices; row r's
+tokens ``[j*T, (j+1)*T)`` live in page ``table[r, j]``): a slot pins
+only the pages its live prefix needs, and the decode step may be traced
+at any TRUNCATED table width n <= max_seq/T — attention math then runs
+over ``n*T`` keys instead of max_seq.
+
+Two parity regimes, deliberately split:
+
+- **Float pages at the FULL table width are bitwise-equal to dense**:
+  the gather reconstructs the exact dense ``[rows, max_seq, H, D]``
+  stripe, then the same `_attend`/mask runs on it — identical logits,
+  bit for bit (tests/test_serve_paged.py). Truncation is NOT bitwise
+  for float: masked tails contribute exact +0.0 to every softmax sum,
+  but a shorter key axis re-tiles XLA's reduction of the NONZERO terms
+  (~1 ulp, same reassociation effect the `_attend` docstring documents
+  for the M dim) — so the serve engine decodes float pages at full
+  width, keeping the memory win and the bitwise twin.
+- **int8 pages (``kv_quant="int8"``) decode at truncated page buckets**
+  and carry the compute win: pools are `ops/quant.QuantizedArray` nodes
+  (int8 + per-token-per-head f32 scales, `quantize_kv`), quantized at
+  write inside the step, dequantized at read — fused in-kernel on TPU
+  (ops/pallas/paged_attention.py), einsum-tiled `_attend_fast` via XLA
+  elsewhere. No bitwise contract to preserve means no broadcast-sum
+  tax either; correctness is the >=0.99 token-agreement gate
+  bench.py --serve --decode holds.
 """
 
 from __future__ import annotations
@@ -42,6 +72,7 @@ from dist_mnist_tpu.cluster.mesh import (
     compat_shard_map,
 )
 from dist_mnist_tpu.ops import nn
+from dist_mnist_tpu.ops.quant import QuantizedArray, quantize_kv
 
 
 def _attend(q, k, v, mask):
@@ -116,6 +147,146 @@ def _decode_attn_update_flash(q, k_new, v_new, k_cache, v_cache, pos):
     return out, k_cache, v_cache
 
 
+# ---- paged KV layout (PR 20) ------------------------------------------
+#
+# A "pool" below is one layer's page store: [pages, page_tokens, heads,
+# head_dim] — either a plain float array or a QuantizedArray (int8 q +
+# [..., heads, 1] f32 scales, mode "kv_head"). Page tables are int32
+# pool indices; entries past a slot's allocation point at the engine's
+# scratch pages, whose garbage is never read (same write-before-attend
+# masking argument as the dense scratch row).
+
+
+def _layer_pool(pool, i):
+    """Layer i's slice of a stacked [depth, ...] pool (either dtype)."""
+    if isinstance(pool, QuantizedArray):
+        return QuantizedArray(pool.q[i], pool.scale[i], pool.mode)
+    return pool[i]
+
+
+def _stack_pools(pools):
+    """Inverse of `_layer_pool`: restack per-layer pools along depth."""
+    if isinstance(pools[0], QuantizedArray):
+        return QuantizedArray(jnp.stack([p.q for p in pools]),
+                              jnp.stack([p.scale for p in pools]),
+                              pools[0].mode)
+    return jnp.stack(pools)
+
+
+def _paged_chunk_write(pool, chunk, page_id):
+    """Prefill write: land ``chunk`` [c<=T, H, D] (float) at the head of
+    page ``page_id``, quantizing on the way in when the pool is int8. A
+    partial chunk (prompt bucket smaller than the page) leaves the tail
+    of the page stale — unread by the masking contract."""
+    at = (page_id, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    if isinstance(pool, QuantizedArray):
+        q, s = quantize_kv(chunk)
+        return QuantizedArray(
+            lax.dynamic_update_slice(pool.q, q[None], at),
+            lax.dynamic_update_slice(pool.scale, s[None], at),
+            pool.mode)
+    return lax.dynamic_update_slice(pool, chunk[None], at)
+
+
+def _paged_token_write(pool, new, page_ids, offs):
+    """Decode write: row r's single token ``new`` [R, 1, H, D] lands at
+    ``(page_ids[r], offs[r])``. Sequential per-row updates (R is a
+    static row count): last-write-wins keeps rows aliased onto shared
+    scratch pages harmless, exactly like the dense scratch row."""
+    r = new.shape[0]
+    if isinstance(pool, QuantizedArray):
+        q, s = quantize_kv(new)
+        pq, ps = pool.q, pool.scale
+        for j in range(r):
+            at = (page_ids[j], offs[j], jnp.int32(0), jnp.int32(0))
+            pq = lax.dynamic_update_slice(pq, q[j][None], at)
+            ps = lax.dynamic_update_slice(ps, s[j][None], at)
+        return QuantizedArray(pq, ps, pool.mode)
+    for j in range(r):
+        at = (page_ids[j], offs[j], jnp.int32(0), jnp.int32(0))
+        pool = lax.dynamic_update_slice(pool, new[j][None], at)
+    return pool
+
+
+def _paged_read(pool, page_table):
+    """Gather a table's pages into the dense view ``[R, n*T, H, D]``
+    attention consumes. Float pools pass through at their stored dtype
+    (the bitwise-twin path); int8 pools dequantize to f32 — `_attend`
+    computes scores/softmax in f32 regardless, so this adds no cast the
+    dense path doesn't already perform."""
+    if isinstance(pool, QuantizedArray):
+        kq = jnp.take(pool.q, page_table, axis=0)
+        ks = jnp.take(pool.scale, page_table, axis=0)
+        r, n, t, h, d = kq.shape
+        return (kq.astype(jnp.float32)
+                * ks.astype(jnp.float32)).reshape(r, n * t, h, d)
+    k = jnp.take(pool, page_table, axis=0)
+    r, n, t, h, d = k.shape
+    return k.reshape(r, n * t, h, d)
+
+
+def _attend_fast(q, k, v, pos):
+    """Key-prefix attention on einsum/dot_general tilings — the fast
+    path for the agreement-gated int8 decode, where no bitwise contract
+    forbids GEMM reassociation (so none of `_attend`'s broadcast-sum
+    tax, and no [B, Sq, Sk, H, D] broadcast intermediate either). f32
+    scores and softmax, -1e30 masking: same accumulation contract,
+    different (tolerance-level) rounding."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32))
+    scores = scores * (1.0 / jnp.sqrt(jnp.float32(dh)))
+    mask = jnp.arange(k.shape[1])[None, None, None, :] \
+        <= pos[:, None, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def _paged_decode_attn_update(q, k_new, v_new, k_pool, v_pool, pos,
+                              page_table):
+    """Paged twin of `_decode_attn_update`: write the token through the
+    page table, then attend over the table's ``n*T`` gathered positions.
+    Float pools run the bitwise `_attend` contract (full-width tables —
+    module docstring); int8 pools take the fused Pallas kernel on TPU
+    (`ops/pallas/paged_attention.use_paged_kernel`) and the einsum
+    `_attend_fast` via XLA elsewhere."""
+    t = (k_pool.q if isinstance(k_pool, QuantizedArray) else k_pool).shape[1]
+    r = q.shape[0]
+    page_ids = page_table[jnp.arange(r), pos // t]
+    offs = pos % t
+    k_pool = _paged_token_write(k_pool, k_new, page_ids, offs)
+    v_pool = _paged_token_write(v_pool, v_new, page_ids, offs)
+    if isinstance(k_pool, QuantizedArray):
+        from dist_mnist_tpu.ops.pallas.paged_attention import (
+            paged_attention,
+            use_paged_kernel,
+        )
+
+        if use_paged_kernel():
+            out = paged_attention(q, k_pool, v_pool, page_table,
+                                  (pos + 1).astype(jnp.int32))
+            return out, k_pool, v_pool
+        k = _paged_read(k_pool, page_table)
+        v = _paged_read(v_pool, page_table)
+        return _attend_fast(q, k, v, pos), k_pool, v_pool
+    k = _paged_read(k_pool, page_table)
+    v = _paged_read(v_pool, page_table)
+    mask = jnp.arange(k.shape[1])[None, None, :] <= pos[:, None, None]
+    return _attend(q, k, v, mask), k_pool, v_pool
+
+
+def _paged_decode_attn_update_gather(q, k_new, v_new, k_pool, v_pool, pos,
+                                     page_table):
+    """Shard-mapped paged decode body: pools stay head-sharded (the
+    [P, T, H, D] heads axis rides the model axis — for int8 pools the
+    rank-4 spec prefixes BOTH q and scale leaves), the attention output
+    gathers like the dense TP path."""
+    o, ck, cv = _paged_decode_attn_update(q, k_new, v_new, k_pool, v_pool,
+                                          pos, page_table)
+    return lax.all_gather(o, MODEL_AXIS, axis=2, tiled=True), ck, cv
+
+
 def _attend_gather(q, k, v, mask):
     """Shard-mapped body for the full-sequence forward: per-device local
     heads, then a tiled all_gather back to the full head axis so the
@@ -176,10 +347,22 @@ class CausalLMTiny:
     # prefill/apply keep the xla path (their causal mask is per-query,
     # not key-only). Tolerance-parity, not bit-parity, vs "xla".
     attention_impl: str = "xla"
+    # "dense": the original [slot, max_seq] stripe cache. "paged": page
+    # pool + caller-owned page table (module docstring) — float pages
+    # stay BITWISE equal to dense; kv_quant="int8" (paged only) stores
+    # pages as QuantizedArray under the >=0.99 agreement gate.
+    cache_layout: str = "dense"
+    kv_page_tokens: int = 16
+    kv_quant: str = "none"
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.heads
+
+    @property
+    def pages_per_slot(self) -> int:
+        """Pages covering one slot's full max_seq stripe (paged layout)."""
+        return self.max_seq // self.kv_page_tokens
 
     def init(self, rng, sample_input=None):
         if self.dim % self.heads:
@@ -189,6 +372,23 @@ class CausalLMTiny:
                 f"unknown attention_impl {self.attention_impl!r}; "
                 "use 'xla' (bit-exact decode) or 'flash' (variable-length "
                 "Pallas decode attention)")
+        if self.cache_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"unknown cache_layout {self.cache_layout!r}; "
+                "use 'dense' | 'paged'")
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"unknown kv_quant {self.kv_quant!r}; use 'none' | 'int8'")
+        if self.kv_quant == "int8" and self.cache_layout != "paged":
+            raise ValueError(
+                "kv_quant='int8' is a paged-layout feature; set "
+                "cache_layout='paged'")
+        if self.cache_layout == "paged":
+            if self.kv_page_tokens < 1 or self.max_seq % self.kv_page_tokens:
+                raise ValueError(
+                    f"kv_page_tokens={self.kv_page_tokens} must divide "
+                    f"max_seq={self.max_seq} — whole pages are what keeps "
+                    "the paged float path bitwise-equal to dense")
         keys = jax.random.split(rng, 3 + self.depth)
         d = self.dim
         params: dict = {
@@ -279,15 +479,37 @@ class CausalLMTiny:
 
     # ---- serving surface (serve/decode.py) ----------------------------
 
-    def init_cache(self, slots: int) -> dict:
-        """Preallocated KV cache: ``[depth, slot, max_seq, heads,
-        head_dim]`` per tensor, zero-filled. The serve engine device_puts
-        this with the heads axis sharded over the model mesh axis."""
-        shape = (self.depth, slots, self.max_seq, self.heads, self.head_dim)
+    def init_cache(self, slots: int, *, num_pages: int | None = None) -> dict:
+        """Preallocated KV cache, layout per ``cache_layout``.
+
+        dense: ``[depth, slot, max_seq, heads, head_dim]`` per tensor,
+        zero-filled. paged: page pools ``[depth, num_pages, page_tokens,
+        heads, head_dim]`` (default ``slots * pages_per_slot`` pages —
+        enough to back every row fully, so the default pool never defers
+        an admission); int8 pools are QuantizedArray nodes. Either way
+        the serve engine device_puts the result with the heads axis (3)
+        sharded over the model mesh axis — the int8 scale leaf is rank-5
+        with heads at the same axis, so one spec covers all layouts."""
+        if self.cache_layout == "dense":
+            shape = (self.depth, slots, self.max_seq, self.heads,
+                     self.head_dim)
+            return {"k": jnp.zeros(shape, self.compute_dtype),
+                    "v": jnp.zeros(shape, self.compute_dtype)}
+        if num_pages is None:
+            num_pages = slots * self.pages_per_slot
+        shape = (self.depth, num_pages, self.kv_page_tokens, self.heads,
+                 self.head_dim)
+        if self.kv_quant == "int8":
+            def pool():
+                return QuantizedArray(
+                    jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1] + (1,), jnp.float32), "kv_head")
+            return {"k": pool(), "v": pool()}
         return {"k": jnp.zeros(shape, self.compute_dtype),
                 "v": jnp.zeros(shape, self.compute_dtype)}
 
-    def prefill(self, params, cache, tokens, slot_ids, lengths):
+    def prefill(self, params, cache, tokens, slot_ids, lengths,
+                page_table=None):
         """Run whole prompts and land their K/V in the cache.
 
         tokens ``[n, S_b]`` (right-padded to the prompt bucket), slot_ids
@@ -295,38 +517,88 @@ class CausalLMTiny:
         slot), lengths ``[n]``. Returns (logits-at-last-real-position
         ``[n, V]``, updated cache). Padding positions >= length DO write
         garbage K/V — harmless, because decode's write-before-attend
-        masking overwrites position p before any query can see it."""
+        masking overwrites position p before any query can see it.
+
+        Paged layout additionally takes ``page_table`` [rows,
+        pages_per_slot] and writes each row's bucket page-chunk by
+        page-chunk through its table row; chunks past a slot's
+        allocation land in scratch pages (stale-never-read)."""
+        paged = self.cache_layout == "paged"
+        if paged and page_table is None:
+            raise ValueError("paged cache_layout needs a page_table")
+        if not paged and page_table is not None:
+            raise ValueError("page_table is a paged-layout argument")
         logits, kv = self._forward(params, tokens)
-        n = tokens.shape[0]
+        n, s_b = tokens.shape
         new_k, new_v = [], []
-        for i, (k, v) in enumerate(kv):
-            ck, cv = cache["k"][i], cache["v"][i]
-            # sequential per-row writes (n is a static bucket size):
-            # last-write-wins keeps duplicate scratch-slot rows harmless
-            for j in range(n):
-                at = (slot_ids[j], jnp.int32(0), jnp.int32(0), jnp.int32(0))
-                ck = lax.dynamic_update_slice(ck, k[j][None], at)
-                cv = lax.dynamic_update_slice(cv, v[j][None], at)
-            new_k.append(ck)
-            new_v.append(cv)
-        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        if paged:
+            t = self.kv_page_tokens
+            n_chunks = -(-s_b // t)
+            table_rows = page_table[slot_ids]  # [n, pages_per_slot]
+            for i, (k, v) in enumerate(kv):
+                pk = _layer_pool(cache["k"], i)
+                pv = _layer_pool(cache["v"], i)
+                for j in range(n):
+                    for c in range(n_chunks):
+                        pid = table_rows[j, c]
+                        pk = _paged_chunk_write(pk, k[j, c * t:(c + 1) * t],
+                                                pid)
+                        pv = _paged_chunk_write(pv, v[j, c * t:(c + 1) * t],
+                                                pid)
+                new_k.append(pk)
+                new_v.append(pv)
+        else:
+            for i, (k, v) in enumerate(kv):
+                ck, cv = cache["k"][i], cache["v"][i]
+                # sequential per-row writes (n is a static bucket size):
+                # last-write-wins keeps duplicate scratch-slot rows harmless
+                for j in range(n):
+                    at = (slot_ids[j], jnp.int32(0), jnp.int32(0),
+                          jnp.int32(0))
+                    ck = lax.dynamic_update_slice(ck, k[j][None], at)
+                    cv = lax.dynamic_update_slice(cv, v[j][None], at)
+                new_k.append(ck)
+                new_v.append(cv)
+        cache = {"k": _stack_pools(new_k), "v": _stack_pools(new_v)}
         last = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
         return last, cache
 
-    def decode_step(self, params, cache, tokens, positions):
+    def decode_step(self, params, cache, tokens, positions,
+                    page_table=None):
         """One token per slot: tokens ``[R]`` are each slot's most recent
         token, positions ``[R]`` where it goes in that slot's sequence.
         Returns (next-token logits ``[R, V]`` f32, updated cache). Each
         slot row only ever reads its own cache rows, so per-request
         streams are independent of batch composition — the invariant that
-        makes continuous and static scheduling bit-identical."""
+        makes continuous and static scheduling bit-identical.
+
+        Paged layout takes ``page_table`` [rows, n] — n may be any page
+        bucket covering every live prefix (``n*T > max(positions)``);
+        attention then costs O(n*T). Float pools keep the bitwise twin
+        contract only at FULL table width; int8 pools are built for
+        truncation (module docstring)."""
+        paged = self.cache_layout == "paged"
+        if paged and page_table is None:
+            raise ValueError("paged cache_layout needs a page_table")
+        if not paged and page_table is not None:
+            raise ValueError("page_table is a paged-layout argument")
         r = tokens.shape[0]
         x = params["tok_emb"][tokens].astype(self.compute_dtype)
         x = (x + params["pos"][0][positions].astype(x.dtype))[:, None, :]
         mesh = ambient_mesh()
         spec = _heads_spec(mesh, self.heads)
-        if spec is None:
+        extra = ()
+        if paged:
+            if spec is None:
+                step = _paged_decode_attn_update
+            else:
+                step = compat_shard_map(
+                    _paged_decode_attn_update_gather, mesh=mesh,
+                    in_specs=(spec,) * 5 + (P(None), P(None, None)),
+                    out_specs=(P(None, None, None, None), spec, spec))
+            extra = (page_table,)
+        elif spec is None:
             # the TP shard_map path stays on _attend regardless of
             # attention_impl: its contract is the gathered bit-stable
             # output, and heads are already device-local there
@@ -343,13 +615,13 @@ class CausalLMTiny:
             p = params[f"block{i}"]
             y = nn.layer_norm(p["ln1"], x)
             q, k, v = self._qkv(p["attn"], y)
-            o, ck, cv = step(q, k, v, cache["k"][i], cache["v"][i],
-                             positions)
+            o, ck, cv = step(q, k, v, _layer_pool(cache["k"], i),
+                             _layer_pool(cache["v"], i), positions, *extra)
             new_k.append(ck)
             new_v.append(cv)
             x = x + nn.dense(p["attn"]["out"], o.reshape(r, 1, self.dim))
             x = self._mlp(p, x)
-        cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        cache = {"k": _stack_pools(new_k), "v": _stack_pools(new_v)}
         x = nn.layer_norm(params["final_ln"], x)
         logits = nn.dense(params["lm_head"], x[:, 0])
         return logits.astype(jnp.float32), cache

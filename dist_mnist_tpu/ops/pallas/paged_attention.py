@@ -1,0 +1,244 @@
+"""Paged-attention decode Pallas kernel: one-token queries against a
+page-table-indirected int8 KV pool.
+
+The decode hot path (models/causal_lm.py paged layout) holds K/V as int8
+page pools ``[pages, page_tokens, heads, head_dim]`` plus per-token-per-
+head f32 scales (`ops/quant.quantize_kv`), with a page table ``[rows,
+n]`` mapping each slot's token range ``[j*T, (j+1)*T)`` to a pool page.
+The XLA reference path gathers the table's pages into a dense
+``[rows, n*T, H, D]`` float copy in HBM before attending; this kernel
+never materializes that copy:
+
+- **Page-table indirection in the index_map**: the flattened table rides
+  `pltpu.PrefetchScalarGridSpec` (scalar-prefetched, so it is available
+  to the BlockSpec index_maps), and each grid step (r, h, ki) DMAs pool
+  page ``table[r, ki]`` directly from HBM into VMEM — int8 bytes plus a
+  thin scale stripe, never a float page.
+- **Per-slot lengths in SMEM**: the second scalar-prefetch operand;
+  ``pl.when(ki * T < length)`` skips the compute of pages past a slot's
+  live prefix (unallocated table entries alias scratch pages, so their
+  fetches are safe and their math is skipped).
+- **Fused dequant in registers**: ``q_int8 * scale`` happens on the VMEM
+  tile right before the two MXU GEMMs, f32 accumulation, online softmax
+  in scratch across the sequential page axis — the masked-flash recipe
+  (ops/pallas/flash_attention.py) at block_q=1.
+
+`interpret=True` (auto off-TPU) runs the same kernel under the Pallas
+interpreter — that is a PARITY surface for tests, not the CPU serving
+path: off-TPU serving uses the XLA gather path (`use_paged_kernel`,
+same auto/pallas/xla dispatch contract as ops/quant.FUSED_MATMUL).
+`paged_attention_cost` is the host-side analytic bytes/FLOPs twin the
+`bench.py --kernels` roofline table consumes.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dist_mnist_tpu.ops.quant import QuantizedArray
+
+# renamed TPUCompilerParams -> CompilerParams in newer jax
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
+#: kernel dispatch mode — "auto" (kernel on TPU, XLA gather elsewhere),
+#: "pallas" (force the kernel; interpret-mode off TPU — tests and
+#: `bench.py --kernels`), "xla" (force the gather reference). Read once
+#: per trace, like ops/quant.FUSED_MATMUL.
+PAGED_ATTENTION = os.environ.get("DMT_PAGED_ATTENTION", "auto")
+
+
+def use_paged_kernel() -> bool:
+    if PAGED_ATTENTION == "pallas":
+        return True
+    if PAGED_ATTENTION == "xla":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _paged_attn_kernel(pt_ref, len_ref, q_ref, kq_ref, ks_ref, vq_ref,
+                       vs_ref, o_ref, vis_ref, m_scr, l_scr, acc_scr,
+                       cnt_scr, *, t: int, n: int, scale: float):
+    """Grid (rows, heads, n_pages), page axis innermost/sequential.
+    pt_ref/len_ref are the scalar-prefetch operands (pt_ref already
+    consumed by the index_maps; len_ref drives the skip predicate)."""
+    r = pl.program_id(0)
+    ki = pl.program_id(2)
+    length = len_ref[r]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        cnt_scr[...] = jnp.zeros_like(cnt_scr)
+
+    @pl.when(ki * t < length)
+    def _page():
+        q = q_ref[0].astype(jnp.float32)  # [1, D]
+        # fused dequant in registers: int8 page tile * [T, 1] scales
+        k = (kq_ref[0, :, 0, :].astype(jnp.float32)
+             * ks_ref[0, :, 0, :].astype(jnp.float32))  # [T, D]
+        v = (vq_ref[0, :, 0, :].astype(jnp.float32)
+             * vs_ref[0, :, 0, :].astype(jnp.float32))
+        logits = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [1, T]
+        col = ki * t + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(col < length, logits, -1e30)
+        m_prev = m_scr[...]  # [1]
+        l_prev = l_scr[...]
+        m_cur = jnp.maximum(m_prev, jnp.max(logits, axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(logits - m_cur[:, None])  # [1, T]
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [1, D]
+        m_scr[...] = m_cur
+        cnt_scr[...] = cnt_scr[...] + 1.0  # visited-page probe
+
+    @pl.when(ki == n - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_scr[...] / l_scr[...][:, None])[0].astype(
+            o_ref.dtype)
+        vis_ref[0, 0] = cnt_scr[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_attention_impl(q, kq, ks, vq, vs, page_table, lengths,
+                          interpret: bool):
+    """q [R, H, D] f32-ish; kq/vq [P, T, H, D] int8 with [P, T, H, 1]
+    f32 scales; page_table [R, n] int32; lengths [R] int32. Returns
+    (out [R, H, D], visits [R, H] f32)."""
+    r, h, d = q.shape
+    t = kq.shape[1]
+    n = page_table.shape[1]
+    scale = d**-0.5
+    pt_flat = page_table.astype(jnp.int32).reshape(-1)
+
+    q_idx = lambda ri, hi, ki, pt, ln: (ri, hi, 0)  # noqa: E731
+    pool_idx = lambda ri, hi, ki, pt, ln: (pt[ri * n + ki], 0, hi, 0)  # noqa: E731
+    q_spec = pl.BlockSpec((1, 1, d), q_idx, memory_space=pltpu.VMEM)
+    pq_spec = pl.BlockSpec((1, t, 1, d), pool_idx, memory_space=pltpu.VMEM)
+    ps_spec = pl.BlockSpec((1, t, 1, 1), pool_idx, memory_space=pltpu.VMEM)
+    vis_spec = pl.BlockSpec((1, 1), lambda ri, hi, ki, pt, ln: (ri, hi),
+                            memory_space=pltpu.VMEM)
+    out, vis = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, t=t, n=n, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(r, h, n),
+            in_specs=[q_spec, pq_spec, ps_spec, pq_spec, ps_spec],
+            out_specs=(q_spec, vis_spec),
+            scratch_shapes=[
+                pltpu.VMEM((1,), jnp.float32),   # running max
+                pltpu.VMEM((1,), jnp.float32),   # running denominator
+                pltpu.VMEM((1, d), jnp.float32),  # output accumulator
+                pltpu.VMEM((1,), jnp.float32),   # visits probe
+            ],
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((r, h, d), q.dtype),
+            jax.ShapeDtypeStruct((r, h), jnp.float32),
+        ),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(pt_flat, lengths.astype(jnp.int32), q, kq, ks, vq, vs)
+    return out, vis
+
+
+def _check_args(q, k_pool, v_pool, page_table, lengths):
+    if not (isinstance(k_pool, QuantizedArray)
+            and isinstance(v_pool, QuantizedArray)):
+        raise ValueError(
+            "paged_attention wants int8 QuantizedArray pools (kv_quant="
+            "'int8'); float pools take the XLA gather path, which is "
+            "already bitwise-exact and needs no kernel")
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(
+            f"q must be [rows, 1, heads, head_dim] (one decode token per "
+            f"slot), got {q.shape}")
+    if page_table.ndim != 2 or page_table.shape[0] != q.shape[0]:
+        raise ValueError(
+            f"page_table must be [rows={q.shape[0]}, n_pages], got "
+            f"{page_table.shape}")
+    if lengths.ndim != 1 or lengths.shape[0] != q.shape[0]:
+        raise ValueError(
+            f"lengths must be [rows={q.shape[0]}], got {lengths.shape}")
+
+
+def paged_attention(q, k_pool, v_pool, page_table, lengths, *,
+                    interpret: bool | None = None):
+    """Single-token paged attention: q ``[R, 1, H, D]`` against int8
+    K/V pools through ``page_table`` [R, n] (row r attends positions
+    ``[0, lengths[r])`` of its gathered ``n*T`` view). Equals the XLA
+    gather+dequant+`_attend` reference to f32 roundoff — the parity
+    tests/test_serve_paged.py and `bench.py --kernels` gate. `interpret`
+    auto-selects off-TPU."""
+    _check_args(q, k_pool, v_pool, page_table, lengths)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, _ = _paged_attention_impl(q[:, 0], k_pool.q, k_pool.scale,
+                                   v_pool.q, v_pool.scale, page_table,
+                                   lengths, interpret)
+    return out[:, None]
+
+
+def paged_attention_probe(q, k_pool, v_pool, page_table, lengths, *,
+                          interpret: bool | None = None):
+    """Forward plus ``visits [R, H]``: pages the kernel actually entered
+    per (row, head) — the structural evidence that pages past a slot's
+    prefix stop paying attention math. ``visits[r] ==
+    paged_attention_pages(lengths, T)[r]`` clipped to the table width."""
+    _check_args(q, k_pool, v_pool, page_table, lengths)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out, vis = _paged_attention_impl(q[:, 0], k_pool.q, k_pool.scale,
+                                     v_pool.q, v_pool.scale, page_table,
+                                     lengths, interpret)
+    return out[:, None], vis
+
+
+def paged_attention_pages(lengths, page_tokens: int):
+    """Active pages per row — ceil(length / T), the exact skip predicate
+    the kernel runs (``ki * T < length``). Shared by tests and the bench
+    so reported FLOPs come from the kernel's own expression."""
+    lengths = jnp.asarray(lengths)
+    return -(-lengths // page_tokens)
+
+
+def paged_attention_cost(lengths, n_pages: int, page_tokens: int,
+                         heads: int, head_dim: int) -> dict:
+    """Analytic roofline inputs for one `paged_attention` call.
+
+    flops: 2 GEMMs (scores + apply) over each row's ACTIVE pages — the
+    `pl.when` skip predicate at block granularity. hbm_bytes: ALL
+    ``n_pages`` page tiles per (row, head) — the pipeline DMAs skipped
+    blocks too (the skip is compute-only), so the bytes win is the
+    page-bucket truncation (n_pages tracks the batch's live prefix, not
+    max_seq) plus int8 storage (1 byte/elem + the [T, 1] scale stripe),
+    NOT the pl.when."""
+    import numpy as np
+
+    active = np.asarray(
+        np.minimum(np.asarray(paged_attention_pages(lengths, page_tokens)),
+                   n_pages)) * page_tokens
+    r = len(active)
+    # lint: ok[host-sync] bench/test-side analytic count on host numpy
+    flops = float((2 * 2 * heads * head_dim * active).sum())
+    page_tile = page_tokens * head_dim + page_tokens * 4  # int8 + f32 scale
+    # lint: ok[host-sync] pure python-int arithmetic, no device values
+    hbm_bytes = float(r * heads * n_pages * 2 * page_tile  # K and V tiles
+                      + 2 * r * heads * head_dim * 4       # q in, out back
+                      + r * n_pages * 4 + r * 4)           # table + lengths
+    return {"flops": flops, "hbm_bytes": hbm_bytes}

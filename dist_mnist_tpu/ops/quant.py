@@ -30,6 +30,17 @@ replaced. Leaves with a zero-amax channel fall back to ONE shared
 per-tensor scale (broadcast to the same keepdims shape so the slicing
 contract holds); `mode` records which rule applied.
 
+KV-cache traversal (serve decode, PR 20): the paged KV cache stores its
+K/V page pools as QuantizedArray nodes with ``mode="kv_head"`` — int8
+``[depth, pages, page_tokens, heads, head_dim]`` with f32 scales
+``[..., heads, 1]``, i.e. the amax runs over the LAST axis (one scale
+per token per head), produced by `quantize_kv` INSIDE the jitted decode
+step (no host pulls — unlike `quantize`, which is load-time-only).
+Because scales keep every leading dim, the engine's single
+``P(None, None, None, model, None)`` TP spec shards q and scale as a
+pytree prefix with no special case, and `dequantize`'s plain broadcast
+multiply recovers float pages unchanged.
+
 Hot-path discipline: everything here is jit-traceable except
 `error_report` (one batched load-time `device_get`) and the degenerate-
 scale check in `quantize` (a load-time scalar `bool`). This file is in
@@ -138,6 +149,25 @@ def quantize(w) -> QuantizedArray:
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
                  -_QMAX, _QMAX).astype(jnp.int8)
     return QuantizedArray(q, scale, mode)
+
+
+def quantize_kv(x):
+    """Symmetric int8 for KV-cache tokens: one scale per token per HEAD
+    (amax over the LAST axis, keepdims) — returns ``(q int8, scale f32)``
+    with ``scale.shape == x.shape[:-1] + (1,)``.
+
+    Differs from `quantize` in two load-bearing ways: the reduction axis
+    is the head_dim (a cache line is consumed whole by attention, not
+    contracted per output channel), and there is NO degenerate-scale host
+    check — this runs inside the jitted decode step every token, so it
+    must stay traceable; a zero-amax token just lands on the `_EPS` floor
+    (q == 0, exact-zero dequant). The caller pairs the result into a
+    ``QuantizedArray(q, scale, mode="kv_head")`` cache node."""
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = (jnp.maximum(amax, _EPS) / _QMAX).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
 
 
 def dequantize(qa: QuantizedArray, dtype=None):

@@ -24,6 +24,22 @@ layers (docs/SERVING.md "Autoregressive decode"):
 - A request's prompt bucket depends on ITS OWN length only, never on
   the admission batch — the property that keeps token streams
   bit-identical between scheduling modes.
+- **Paged KV cache** (``cache_layout="paged"`` models): the cache is a
+  device page POOL ``[depth, num_pages, page_tokens, heads, head_dim]``
+  and the engine owns a host-side page table ``[rows, pages_per_slot]``
+  int32 plus a free list. Pages are pinned at admission
+  (`try_reserve`) and reclaimed at eviction (`release_slot`) —
+  `kv_page_alloc`/`kv_page_reclaim` journal events — and the
+  memory-budget accounting (`CompiledModelCache.set_base_bytes`)
+  charges params + scratch + PINNED pages instead of the dense worst
+  case, so `--serve_memory_budget_mb` eviction decisions see real
+  residency (a 40-token slot pins pages for 40 tokens, not a max_seq
+  stripe). Unallocated table entries alias the reserved scratch pages
+  (written only by rows whose output is discarded, never read by live
+  rows). Each decode step picks the smallest ``("decode", p)``
+  page-bucket cell covering the live prefix and passes the truncated
+  table as a REPLICATED jit argument — the table is data, not donated
+  device state, so host-side alloc/free never races the step.
 
 `DecodeScheduler` — **continuous batching** over the engine's slots (one
 daemon thread, name prefix ``DecodeScheduler`` in the conftest leak
@@ -37,6 +53,17 @@ slots (per-token throughput). ``mode="static"`` is the measured
 baseline: admit a batch, decode until EVERY member finishes, only then
 admit again — same executables, same per-request streams, strictly worse
 tail TTFT (bench.py --serve --decode shows the gap).
+
+``runahead=1`` (the default, mirroring ``TrainLoop(runahead=k)``)
+overlaps host scheduling with the device step in continuous mode: the
+loop dispatches the step without syncing (`DecodeEngine.decode_async`),
+runs admission bookkeeping + page allocation while the device computes,
+then harvests the token ids (`decode_harvest`) and prefills the admitted
+batch. Per-slot streams are independent of batch composition, so overlap
+moves WHEN a request is admitted (by at most one step), never the tokens
+it produces. ``runahead=0`` restores the serial admit-then-step loop. No
+extra threads are created — the conftest leak registry still watches the
+single ``DecodeScheduler`` prefix.
 """
 
 from __future__ import annotations
@@ -89,7 +116,8 @@ class DecodeEngine:
     def __init__(self, model, params, mesh: Mesh, *,
                  model_name: str = "causal_lm", grid=None,
                  max_slots: int = 8, store=None,
-                 cache: CompiledModelCache | None = None):
+                 cache: CompiledModelCache | None = None,
+                 num_pages: int | None = None):
         from dist_mnist_tpu.serve.zoo import default_decode_grid
 
         self.model = model
@@ -121,25 +149,181 @@ class DecodeEngine:
             mesh, P(None, None, None, MODEL_AXIS, None))
             if m > 1 else self._rep)
         self.params = jax.device_put(params, self._rep)
+        self.layout = getattr(model, "cache_layout", "dense")
+        self.kv_quant = getattr(model, "kv_quant", "none")
+        self.page_tokens = (int(model.kv_page_tokens)
+                            if self.layout == "paged" else 0)
+        if self.layout == "paged":
+            if not self.grid.decode_page_buckets:
+                raise ValueError(
+                    "paged model needs a grid with decode_page_buckets "
+                    "(serve/zoo.default_decode_grid derives them)")
+            if self.grid.decode_page_buckets[-1] != model.pages_per_slot:
+                raise ValueError(
+                    f"widest decode page bucket "
+                    f"{self.grid.decode_page_buckets[-1]} != "
+                    f"pages_per_slot {model.pages_per_slot}")
+            kv_host = model.init_cache(self.grid.rows, num_pages=num_pages)
+        else:
+            if self.grid.decode_page_buckets:
+                raise ValueError("dense model with paged decode buckets")
+            kv_host = model.init_cache(self.grid.rows)
         #: the live cache state: slots + 1 rows (scratch row absorbs
         #: prefill-padding writes), donated to and rebound from every step
-        self.kv = jax.device_put(model.init_cache(self.grid.rows),
-                                 self._kv_shd)
-        base = sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                   for a in jax.tree.leaves(self.params)) \
-            + sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                  for a in jax.tree.leaves(self.kv))
-        self.cache.set_base_bytes(base // max(1, mesh.size))
+        self.kv = jax.device_put(kv_host, self._kv_shd)
+        self._params_bytes = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in jax.tree.leaves(self.params))
+        self._kv_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                             for a in jax.tree.leaves(self.kv))
+        if self.layout == "paged":
+            pps = int(model.pages_per_slot)
+            self.num_pages = int(jax.tree.leaves(self.kv)[0].shape[1])
+            if self.num_pages < 2 * pps:
+                raise ValueError(
+                    f"num_pages {self.num_pages} < {2 * pps}: the pool "
+                    "needs the scratch stripe plus at least one full slot")
+            self._page_bytes = self._kv_bytes // self.num_pages
+            # the LAST pages_per_slot page ids are the permanent scratch
+            # stripe: the scratch row's table points at them forever, and
+            # every unallocated table entry aliases them
+            self._scratch_pages = np.arange(self.num_pages - pps,
+                                            self.num_pages, dtype=np.int32)
+            self._free_pages = list(range(self.num_pages - pps))
+            self._slot_pages: dict = {}
+            self._page_table = np.tile(self._scratch_pages,
+                                       (self.grid.rows, 1))
+            # committed device copies of the (truncated) table, keyed by
+            # width and dirtied on every alloc/free: the table only
+            # changes at admission/finish boundaries, so steady-state
+            # decode steps re-use one device buffer instead of paying a
+            # host->device table transfer per step
+            self._table_device: dict = {}
+            self._peak_pinned = 0
+            self._update_base_bytes()
+        else:
+            self.cache.set_base_bytes(
+                (self._params_bytes + self._kv_bytes) // max(1, mesh.size))
+
+    # -- paged-cache page management (host-owned; no-ops for dense) ---------
+
+    def _update_base_bytes(self) -> None:
+        """Re-derive the memory-budget floor from pages actually pinned:
+        params + the scratch stripe + every allocated page. The byte-
+        accounting fix over the dense engine, which charged the full
+        worst-case KV allocation up front."""
+        pinned = sum(len(p) for p in self._slot_pages.values())
+        self._peak_pinned = max(self._peak_pinned, pinned)
+        resident = (self._params_bytes + self._page_bytes
+                    * (len(self._scratch_pages) + pinned))
+        self.cache.set_base_bytes(resident // max(1, self.mesh.size))
+
+    def _device_table(self, width: int):
+        """The page table's first `width` columns as a committed device
+        array, cached until an alloc/free dirties it. Committing also
+        freezes the in-flight step's view: host-side bookkeeping after
+        dispatch mutates the numpy table, never this buffer."""
+        tab = self._table_device.get(width)
+        if tab is None:
+            tab = jax.device_put(
+                np.ascontiguousarray(self._page_table[:, :width]),
+                self._rep)
+            self._table_device[width] = tab
+        return tab
+
+    def try_reserve(self, slot: int, total_len: int) -> bool:
+        """Pin the pages `slot` needs for a prompt + full generation of
+        `total_len` tokens; False when the free pool can't cover it (the
+        scheduler defers the admission). Dense layout: always True."""
+        if self.layout != "paged":
+            return True
+        n = -(-int(total_len) // self.page_tokens)
+        if n > self._page_table.shape[1]:
+            raise ValueError(
+                f"{total_len} tokens need {n} pages > pages_per_slot "
+                f"{self._page_table.shape[1]}")
+        if len(self._free_pages) < n:
+            return False
+        pages = [self._free_pages.pop(0) for _ in range(n)]
+        self._page_table[slot, :n] = pages
+        self._slot_pages[slot] = pages
+        self._table_device.clear()
+        self._update_base_bytes()
+        events.emit("kv_page_alloc", slot=int(slot), pages=n,
+                    free=len(self._free_pages))
+        return True
+
+    def release_slot(self, slot: int) -> None:
+        """Reclaim a finished slot's pages and re-alias its table row to
+        the scratch stripe. Idempotent; no-op for dense."""
+        if self.layout != "paged":
+            return
+        pages = self._slot_pages.pop(slot, None)
+        if not pages:
+            return
+        self._free_pages.extend(pages)
+        self._page_table[slot] = self._scratch_pages
+        self._table_device.clear()
+        self._update_base_bytes()
+        events.emit("kv_page_reclaim", slot=int(slot), pages=len(pages),
+                    free=len(self._free_pages))
+
+    def reset_pages(self) -> None:
+        """Reclaim EVERY slot's pages — the scheduler's crash-recovery
+        hook, paired with its slot-table reset."""
+        if self.layout != "paged":
+            return
+        for slot in list(self._slot_pages):
+            self.release_slot(slot)
+
+    def kv_stats(self) -> dict:
+        """Residency counters for metrics/bench: pages + bytes pinned vs
+        the pool. Dense reports its whole allocation as pinned — that IS
+        its residency, which is the point of the comparison."""
+        if self.layout != "paged":
+            return {"layout": "dense", "kv_quant": self.kv_quant,
+                    "page_tokens": 0, "kv_pages_total": 0,
+                    "kv_pages_pinned": 0,
+                    "kv_bytes_pinned": self._kv_bytes,
+                    "kv_bytes_peak": self._kv_bytes,
+                    "kv_bytes_pool": self._kv_bytes}
+        pinned = sum(len(p) for p in self._slot_pages.values())
+        scratch = len(self._scratch_pages)
+        return {"layout": "paged", "kv_quant": self.kv_quant,
+                "page_tokens": self.page_tokens,
+                "kv_pages_total": self.num_pages,
+                "kv_pages_pinned": pinned,
+                "kv_bytes_pinned": self._page_bytes * pinned,
+                # high-water residency incl. the scratch stripe: what the
+                # bench's <=0.35x-dense contract is asserted against
+                "kv_bytes_peak": self._page_bytes
+                * (scratch + self._peak_pinned),
+                "kv_bytes_pool": self._page_bytes * self.num_pages}
 
     # -- compilation --------------------------------------------------------
 
     def _mesh_key(self):
         return tuple(sorted(dict(self.mesh.shape).items()))
 
+    def _layout_key(self) -> tuple:
+        """Everything about the KV layout that changes the compiled
+        program: the layout itself, page size, quantization, and (for
+        int8, where it selects the attention implementation at trace
+        time) the paged-kernel dispatch. Tuned knobs (`kv_page_tokens`,
+        `decode_admit_buckets` — the latter via the grid cell) fold into
+        the executable key HERE, the contract the graftlint cache-key
+        rule cross-checks."""
+        from dist_mnist_tpu.ops.pallas.paged_attention import \
+            use_paged_kernel
+
+        kernel = use_paged_kernel() if self.kv_quant == "int8" else False
+        return (self.layout, self.page_tokens, self.kv_quant, kernel,
+                getattr(self.model, "attention_impl", "xla"))
+
     def _key(self, cell: tuple):
         dt = str(jnp.dtype(self.model.compute_dtype))
         return (self.model_name, "decode_grid", cell, self.grid.rows,
-                self.max_seq, self._mesh_key(), dt)
+                self.max_seq, self._mesh_key(), dt, self._layout_key())
 
     def _store_key(self, cell: tuple) -> str | None:
         if self.cache._store is None:
@@ -154,6 +338,7 @@ class DecodeEngine:
             "max_seq": self.max_seq,
             "mesh": self._mesh_key(),
             "dtype": str(jnp.dtype(self.model.compute_dtype)),
+            "layout": list(self._layout_key()),
         })
 
     def _abstract_kv(self):
@@ -161,8 +346,26 @@ class DecodeEngine:
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                            sharding=self._kv_shd), self.kv)
 
-    def _compile_decode(self):
+    def _compile_decode(self, cell: tuple):
         rows = self.grid.rows
+        ivec = jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=self._rep)
+        if self.layout == "paged":
+            def step(params, kv, tokens, positions, page_table):
+                logits, kv = self.model.decode_step(
+                    params, kv, tokens, positions, page_table=page_table)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+            jitted = jax.jit(
+                step,
+                in_shardings=(self._rep, self._kv_shd, self._rep,
+                              self._rep, self._rep),
+                out_shardings=(self._rep, self._kv_shd),
+                donate_argnums=(1,))
+            pt = jax.ShapeDtypeStruct((rows, cell[1]), jnp.int32,
+                                      sharding=self._rep)
+            with activate(self.mesh):
+                return jitted.lower(self.params, self._abstract_kv(),
+                                    ivec, ivec, pt).compile()
 
         def step(params, kv, tokens, positions):
             logits, kv = self.model.decode_step(params, kv, tokens,
@@ -176,12 +379,37 @@ class DecodeEngine:
             in_shardings=(self._rep, self._kv_shd, self._rep, self._rep),
             out_shardings=(self._rep, self._kv_shd),
             donate_argnums=(1,))
-        ivec = jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=self._rep)
         with activate(self.mesh):
             return jitted.lower(self.params, self._abstract_kv(),
                                 ivec, ivec).compile()
 
     def _compile_prefill(self, n_bucket: int, s_bucket: int):
+        toks = jax.ShapeDtypeStruct((n_bucket, s_bucket), jnp.int32,
+                                    sharding=self._rep)
+        ivec = jax.ShapeDtypeStruct((n_bucket,), jnp.int32,
+                                    sharding=self._rep)
+        if self.layout == "paged":
+            def fwd(params, kv, tokens, slot_ids, lengths, page_table):
+                logits, kv = self.model.prefill(
+                    params, kv, tokens, slot_ids, lengths,
+                    page_table=page_table)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+            jitted = jax.jit(
+                fwd,
+                in_shardings=(self._rep, self._kv_shd, self._rep,
+                              self._rep, self._rep, self._rep),
+                out_shardings=(self._rep, self._kv_shd),
+                donate_argnums=(1,))
+            # prefill always sees the FULL-width table: chunk writes are
+            # table lookups, not attention, so there's nothing to truncate
+            pt = jax.ShapeDtypeStruct(
+                (self.grid.rows, self.model.pages_per_slot), jnp.int32,
+                sharding=self._rep)
+            with activate(self.mesh):
+                return jitted.lower(self.params, self._abstract_kv(),
+                                    toks, ivec, ivec, pt).compile()
+
         def fwd(params, kv, tokens, slot_ids, lengths):
             logits, kv = self.model.prefill(params, kv, tokens, slot_ids,
                                             lengths)
@@ -193,19 +421,15 @@ class DecodeEngine:
                           self._rep),
             out_shardings=(self._rep, self._kv_shd),
             donate_argnums=(1,))
-        toks = jax.ShapeDtypeStruct((n_bucket, s_bucket), jnp.int32,
-                                    sharding=self._rep)
-        ivec = jax.ShapeDtypeStruct((n_bucket,), jnp.int32,
-                                    sharding=self._rep)
         with activate(self.mesh):
             return jitted.lower(self.params, self._abstract_kv(),
                                 toks, ivec, ivec).compile()
 
     def compiled_for(self, cell: tuple):
-        """The executable for a grid cell: ``("decode",)`` or
-        ``("prefill", n_bucket, s_bucket)``."""
+        """The executable for a grid cell: ``("decode",)`` /
+        ``("decode", p)`` or ``("prefill", n_bucket, s_bucket)``."""
         if cell[0] == "decode":
-            build = self._compile_decode
+            build = lambda: self._compile_decode(cell)  # noqa: E731
         else:
             _, n_b, s_b = cell
             build = lambda: self._compile_prefill(n_b, s_b)  # noqa: E731
@@ -255,8 +479,13 @@ class DecodeEngine:
                     slots[row] = slot_ids[i]
                     lengths[row] = len(prompts[i])
                 exe = self.compiled_for(("prefill", n_b, s_b))
-                first, self.kv = exe(self.params, self.kv, tokens, slots,
-                                     lengths)
+                if self.layout == "paged":
+                    first, self.kv = exe(
+                        self.params, self.kv, tokens, slots, lengths,
+                        self._device_table(self._page_table.shape[1]))
+                else:
+                    first, self.kv = exe(self.params, self.kv, tokens,
+                                         slots, lengths)
                 # one intentional sync per admission: the scheduler needs
                 # the first token on host to stream it / update slot state
                 first = np.asarray(jax.device_get(first))  # lint: ok[host-sync] scheduler consumes token ids on host
@@ -264,19 +493,43 @@ class DecodeEngine:
                     out[i] = first[row]
         return out
 
+    def decode_async(self, tokens: np.ndarray, positions: np.ndarray):
+        """Dispatch one decode step WITHOUT syncing: returns the
+        on-device next-token vector. Pair with `decode_harvest` — the
+        seam the scheduler's runahead overlap is built on: host
+        admission/page bookkeeping runs between dispatch and harvest.
+
+        Paged engines pick the smallest page-bucket cell covering the
+        live prefix here (host arithmetic over the positions the caller
+        already holds — no device readback) and pass a truncated COPY of
+        the page table, so later host-side alloc/free can't touch the
+        in-flight step's view."""
+        tokens = np.asarray(tokens, np.int32)
+        positions = np.asarray(positions, np.int32)
+        if self.layout == "paged":
+            needed = -(-(int(positions.max()) + 1) // self.page_tokens)
+            p = self.grid.decode_page_bucket_for(needed)
+            exe = self.compiled_for(("decode", p))
+            nxt, self.kv = exe(self.params, self.kv, tokens, positions,
+                               self._device_table(p))
+        else:
+            exe = self.compiled_for(("decode",))
+            nxt, self.kv = exe(self.params, self.kv, tokens, positions)
+        return nxt
+
+    def decode_harvest(self, nxt) -> np.ndarray:
+        """Block on a `decode_async` result and return host token ids."""
+        # the one per-step sync decode serving cannot avoid: token ids
+        # drive host-side stop/admit decisions
+        return np.asarray(jax.device_get(nxt))  # lint: ok[host-sync] scheduler consumes token ids on host
+
     def decode(self, tokens: np.ndarray, positions: np.ndarray) -> np.ndarray:
         """One step for every slot row: feed each slot's latest token at
         its position, get back next-token ids ``[rows]`` int32. Inactive
         rows compute garbage that their next prefill overwrites — the
         batch shape never changes, which is why admission/eviction can
         happen between any two steps without recompiling."""
-        exe = self.compiled_for(("decode",))
-        nxt, self.kv = exe(self.params, self.kv,
-                           np.asarray(tokens, np.int32),
-                           np.asarray(positions, np.int32))
-        # the one per-step sync decode serving cannot avoid: token ids
-        # drive host-side stop/admit decisions
-        return np.asarray(jax.device_get(nxt))  # lint: ok[host-sync] scheduler consumes token ids on host
+        return self.decode_harvest(self.decode_async(tokens, positions))
 
     def stats(self) -> dict:
         return self.cache.stats()
@@ -326,12 +579,16 @@ class DecodeScheduler:
 
     def __init__(self, engine: DecodeEngine, *, mode: str = "continuous",
                  max_queue: int = 256, metrics: DecodeMetrics | None = None,
-                 writer=None):
+                 writer=None, runahead: int = 1):
         if mode not in ("continuous", "static"):
             raise ValueError(f"unknown mode {mode!r}; "
                              "use 'continuous' | 'static'")
+        if runahead not in (0, 1):
+            raise ValueError("runahead must be 0 (serial) or 1 (overlap "
+                             "host scheduling with the device step)")
         self.engine = engine
         self.mode = mode
+        self.runahead = runahead
         self.max_queue = max_queue
         self.metrics = metrics if metrics is not None else DecodeMetrics()
         self.writer = writer
@@ -442,6 +699,7 @@ class DecodeScheduler:
                 q.clear()
             orphans.extend(self._active.values())
             self._active.clear()
+            self.engine.reset_pages()
         for req in orphans:
             if not req.future.done():
                 req.future.set_exception(
@@ -451,7 +709,8 @@ class DecodeScheduler:
                     failed=self.metrics.failed)
         if self.writer is not None:
             self.metrics.emit(self.writer, next(self._emit_step),
-                              queue_depth=0, cache=self.engine.stats())
+                              queue_depth=0, cache=self.engine.stats(),
+                              kv=self.engine.kv_stats())
 
     def __enter__(self):
         return self
@@ -464,13 +723,21 @@ class DecodeScheduler:
 
     def _take_admissions(self) -> list:
         """Pop (request, slot) assignments under the lock: LS queue fully
-        before BE (the TTFT priority), one free slot each."""
+        before BE (the TTFT priority), one free slot each. Paged engines
+        additionally pin the slot's KV pages here; a request whose pages
+        don't fit stays at the HEAD of its queue (admission order is
+        preserved) until evictions reclaim enough pool."""
         out = []
         with self._lock:
             while self._free:
                 for cls in (LATENCY_SENSITIVE, BEST_EFFORT):
                     if self._pending[cls]:
-                        seq, req = self._pending[cls].popleft()
+                        seq, req = self._pending[cls][0]
+                        total = int(req.prompt.size) + req.max_new_tokens
+                        if not self.engine.try_reserve(self._free[0],
+                                                       total):
+                            return out
+                        self._pending[cls].popleft()
                         req.slot = self._free.pop(0)
                         self.admit_log.append((seq, cls))
                         out.append(req)
@@ -505,6 +772,7 @@ class DecodeScheduler:
     def _finish_locked(self, r, now: float) -> None:
         slot = r.slot
         self._active.pop(slot, None)
+        self.engine.release_slot(slot)
         self._free.append(slot)
         self._tokens[slot] = 0
         self._positions[slot] = 0
@@ -523,7 +791,11 @@ class DecodeScheduler:
             prompt_len=int(r.prompt.size)))
 
     def _step(self) -> None:
-        nxt = self.engine.decode(self._tokens, self._positions)
+        self._harvest(self.engine.decode_async(self._tokens,
+                                               self._positions))
+
+    def _harvest(self, nxt_dev) -> None:
+        nxt = self.engine.decode_harvest(nxt_dev)
         now = time.monotonic()
         with self._lock:
             self.metrics.record_step(len(self._active))
@@ -541,20 +813,35 @@ class DecodeScheduler:
                 self._finish_locked(r, now)
 
     def _loop(self) -> None:
+        overlap = self.runahead > 0
         while not self._stop.is_set():
             try:
-                if self.mode == "continuous" or not self._active:
+                if not self._active or (self.mode == "continuous"
+                                        and not overlap):
                     reqs = self._take_admissions()
                     if reqs:
                         self._admit(reqs)
                 if self._active:
-                    self._step()
+                    if overlap and self.mode == "continuous":
+                        # host/device overlap: admission bookkeeping +
+                        # page allocation run while the dispatched step
+                        # computes; the admitted batch prefills after
+                        # harvest (bounded runahead=1)
+                        nxt_dev = self.engine.decode_async(
+                            self._tokens, self._positions)
+                        reqs = self._take_admissions()
+                        self._harvest(nxt_dev)
+                        if reqs:
+                            self._admit(reqs)
+                    else:
+                        self._step()
                     continue
             except Exception:  # pragma: no cover - defensive
                 log.exception("decode scheduler step failed")
                 with self._lock:
                     broken = list(self._active.values())
                     self._active.clear()
+                    self.engine.reset_pages()
                     self._free = list(range(self.engine.max_slots))
                 for r in broken:
                     if not r.future.done():

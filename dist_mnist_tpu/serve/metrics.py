@@ -320,9 +320,11 @@ class DecodeMetrics:
         return out
 
     def emit(self, writer, step: int, *, queue_depth: int | None = None,
-             cache: dict | None = None) -> None:
+             cache: dict | None = None, kv: dict | None = None) -> None:
         """Write the snapshot through an obs MetricWriter — one batched
-        `scalars()` call, same cadence convention as `ServeMetrics.emit`."""
+        `scalars()` call, same cadence convention as `ServeMetrics.emit`.
+        `kv` is a `DecodeEngine.kv_stats()` dict; when given, the paged
+        KV residency gauges (`serve/decode_kv_*`) ride along."""
         snap = self.snapshot()
         vals: dict[str, float] = {}
         vals["serve/decode_submitted"] = snap["submitted"]
@@ -344,6 +346,10 @@ class DecodeMetrics:
         if cache:
             vals["serve/cache_hits"] = cache.get("hits", 0)
             vals["serve/cache_misses"] = cache.get("misses", 0)
+        if kv:
+            vals["serve/decode_kv_pages_pinned"] = kv["kv_pages_pinned"]
+            vals["serve/decode_kv_bytes_pinned"] = kv["kv_bytes_pinned"]
+            vals["serve/decode_kv_bytes_pool"] = kv["kv_bytes_pool"]
         batch_write = getattr(writer, "scalars", None)
         if callable(batch_write):
             batch_write(vals, step)

@@ -289,10 +289,17 @@ class DecodeGrid:
       the batch's max — a request's prefill program must not depend on
       who it was admitted with, or token streams would differ between
       scheduling modes), and batched up to ``admit_buckets``.
-    - **one decode cell**: the single-token step is compiled once at the
-      full slot capacity (+1 scratch row prefill padding lands in) and
-      every step runs it — continuous batching admits/evicts by editing
-      the per-slot token/position vectors, never by reshaping the batch.
+    - **decode cells**: the single-token step is compiled at the full
+      slot capacity (+1 scratch row prefill padding lands in) and every
+      step runs one — continuous batching admits/evicts by editing the
+      per-slot token/position vectors, never by reshaping the batch.
+      The dense layout has exactly one decode cell, ``("decode",)``.
+      The paged layout compiles one ``("decode", p)`` cell per entry of
+      ``decode_page_buckets`` (page-table widths): each step picks the
+      smallest bucket covering the batch's live prefix, so attention
+      cost tracks real lengths instead of max_seq. Float paged grids
+      carry only the full-width bucket (truncation is not bitwise —
+      models/causal_lm.py); int8 grids carry the power-of-two ladder.
 
     Prewarming every cell is what makes mixed prefill/decode traffic
     recompile-free (the acceptance bar bench.py --serve --decode holds).
@@ -302,6 +309,8 @@ class DecodeGrid:
     max_seq: int = 64
     prompt_buckets: tuple = ()
     admit_buckets: tuple = ()
+    #: page-table width buckets for the paged decode cells; () = dense
+    decode_page_buckets: tuple = ()
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -313,8 +322,12 @@ class DecodeGrid:
         ab = tuple(sorted({int(b) for b in self.admit_buckets}))
         if not ab or any(b < 1 for b in ab):
             raise ValueError(f"admit buckets {ab} must be >= 1")
+        dp = tuple(sorted({int(b) for b in self.decode_page_buckets}))
+        if any(b < 1 for b in dp):
+            raise ValueError(f"decode page buckets {dp} must be >= 1")
         object.__setattr__(self, "prompt_buckets", pb)
         object.__setattr__(self, "admit_buckets", ab)
+        object.__setattr__(self, "decode_page_buckets", dp)
 
     @property
     def rows(self) -> int:
@@ -345,11 +358,30 @@ class DecodeGrid:
             f"admission of {n} > largest admit bucket "
             f"{self.admit_buckets[-1]}; chunk upstream")
 
+    def decode_page_bucket_for(self, n_pages: int) -> int:
+        """Smallest page-table-width bucket covering the live prefix of
+        `n_pages` pages (paged layout only)."""
+        if not self.decode_page_buckets:
+            raise ValueError("grid has no decode page buckets (dense)")
+        if n_pages < 1:
+            raise ValueError("empty prefix")
+        for b in self.decode_page_buckets:
+            if b >= n_pages:
+                return b
+        raise ValueError(
+            f"prefix of {n_pages} pages > widest decode bucket "
+            f"{self.decode_page_buckets[-1]}")
+
     def cells(self) -> list:
-        """Every compiled program: ('prefill', n, s) cells + ('decode',)."""
+        """Every compiled program: ('prefill', n, s) cells plus the
+        decode cells — ('decode',) for dense, ('decode', p) per page
+        bucket for paged."""
         out = [("prefill", n, s) for n in self.admit_buckets
                for s in self.prompt_buckets]
-        out.append(("decode",))
+        if self.decode_page_buckets:
+            out.extend(("decode", p) for p in self.decode_page_buckets)
+        else:
+            out.append(("decode",))
         return out
 
 
@@ -357,7 +389,11 @@ def default_decode_grid(model, *, max_slots: int = 8,
                         prompt_buckets=None) -> DecodeGrid:
     """Power-of-two prompt buckets up to the model's max_seq (floored at
     4 tokens — tinier programs aren't worth their cache slots), admit
-    buckets up to the slot count."""
+    buckets up to the slot count. Paged models additionally get decode
+    page buckets: the power-of-two ladder up to pages_per_slot when the
+    KV is int8 (truncated cells live under the agreement gate), but only
+    the full width for float KV — truncating the key axis re-tiles the
+    XLA reduction and breaks the bitwise decode==dense contract."""
     max_seq = int(model.max_seq)
     if prompt_buckets is None:
         buckets, b = [], 4
@@ -372,9 +408,19 @@ def default_decode_grid(model, *, max_slots: int = 8,
         admits.append(a)
         a *= 2
     admits.append(max_slots)
+    pages = []
+    if getattr(model, "cache_layout", "dense") == "paged":
+        pps = model.pages_per_slot
+        if getattr(model, "kv_quant", "none") == "int8":
+            p = 1
+            while p < pps:
+                pages.append(p)
+                p *= 2
+        pages.append(pps)
     return DecodeGrid(max_slots=max_slots, max_seq=max_seq,
                       prompt_buckets=tuple(buckets),
-                      admit_buckets=tuple(admits))
+                      admit_buckets=tuple(admits),
+                      decode_page_buckets=tuple(pages))
 
 
 def build_decode_engine(

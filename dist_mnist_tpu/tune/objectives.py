@@ -35,6 +35,12 @@ LAUNCH_TOLL_MB = 0.25
 #: the serve memory budget (serve/engine.py prewarm / ServeMemoryBudget)
 CELL_TOLL = 0.02
 
+#: per page-table-entry toll for the kv_page_tokens objective: every
+#: extra page per slot is one more int32 of table the decode step
+#: indirects through and (int8) one more decode grid cell to prewarm —
+#: the pressure that stops the search from always picking tiny pages
+PAGE_TOLL = 0.01
+
 
 class TuneObjectiveUnavailable(RuntimeError):
     """This geometry cannot measure the knob (e.g. 1 chip: no fsdp
@@ -202,6 +208,104 @@ def moe_capacity_objective(*, n_experts: int = 8, tokens: int = 256,
             "tokens": tokens,
             "routing_alpha": alpha,
             "batches": budget,
+            "seed": seed,
+        }
+
+    return objective
+
+
+# ------------------------------------------------------------ kv_page_tokens
+
+def kv_page_objective(*, max_seq: int = 64):
+    """Objective for `kv_page_tokens`: deterministic page economics over
+    the seeded decode traffic shape. Each trial draws request lengths
+    with `serve/loadgen.make_prompts` (the SAME distribution the decode
+    bench replays), pins ``ceil((prompt + max_new) / T)`` pages per
+    request — exactly what `serve/decode.DecodeEngine.try_reserve` does —
+    and charges (a) the fraction of pinned page tokens the request never
+    fills (tail-page waste) and (b) PAGE_TOLL per page of table width
+    (`pages_per_slot`), the indirection + extra-grid-cell pressure.
+    Small pages waste nothing but widen every table; big pages pin
+    near-dense stripes. Pure seeded arithmetic on every backend."""
+    from dist_mnist_tpu.serve.loadgen import make_prompts
+
+    def objective(candidate, *, budget: int, seed: int):
+        t = int(candidate)  # lint: ok[host-sync] host-side candidate arithmetic, no device value involved
+        if t < 1 or max_seq % t:
+            raise TuneObjectiveUnavailable(
+                f"kv_page_tokens={t} must divide max_seq={max_seq} "
+                "(models/causal_lm.py paged-layout contract)")
+        reqs = make_prompts(max(1, budget) * 32, max_seq=max_seq,
+                            seed=seed)
+        totals = np.array([p.size + n for p, n in reqs], dtype=np.int64)
+        pages = -(-totals // t)
+        waste = (pages * t - totals) / (pages * t)
+        pages_per_slot = max_seq // t
+        score = float(waste.mean()) + PAGE_TOLL * pages_per_slot  # lint: ok[host-sync] seeded numpy cost model, no device values
+        return score, {
+            "page_tokens": t,
+            "pages_per_slot": pages_per_slot,
+            "mean_tail_waste": round(float(waste.mean()), 4),  # lint: ok[host-sync] seeded numpy cost model, no device values
+            "mean_pages_pinned": round(float(pages.mean()), 3),  # lint: ok[host-sync] seeded numpy cost model, no device values
+            "page_toll": PAGE_TOLL,
+            "max_seq": max_seq,
+            "requests": len(reqs),
+            "budget": budget,
+            "seed": seed,
+        }
+
+    return objective
+
+
+# ------------------------------------------------------ decode_admit_buckets
+
+def decode_admit_objective(*, max_slots: int = 8, max_seq: int = 64):
+    """Objective for `decode_admit_buckets`: replay a seeded admission-
+    size stream through the real `serve/zoo.DecodeGrid` bucketing
+    arithmetic and charge every padded prefill row (a padded row runs
+    the full prompt-bucket forward into the scratch slot for nothing),
+    plus CELL_TOLL per (admit x prompt) grid cell — every admit bucket
+    multiplies the prefill programs to prewarm and keep resident. The
+    admission sizes mirror what continuous batching hands `prefill`:
+    bursts capped by free slots, drawn seeded per trial."""
+    from dist_mnist_tpu.serve.zoo import DecodeGrid
+
+    def parse(spec_str: str) -> tuple:
+        if spec_str == "auto":
+            out, a = [], 1
+            while a < max_slots:
+                out.append(a)
+                a *= 2
+            out.append(max_slots)
+            return tuple(out)
+        return tuple(int(b) for b in spec_str.split(","))
+
+    def objective(candidate, *, budget: int, seed: int):
+        buckets = parse(str(candidate))  # lint: ok[host-sync] host-side candidate arithmetic, no device value involved
+        if not buckets or buckets[-1] != max_slots:
+            raise TuneObjectiveUnavailable(
+                f"admit buckets {buckets} must end at max_slots="
+                f"{max_slots} or full admissions cannot land")
+        grid = DecodeGrid(max_slots=max_slots, max_seq=max_seq,
+                          prompt_buckets=(max_seq,),
+                          admit_buckets=buckets)
+        rng = np.random.default_rng(seed)
+        sizes = rng.integers(1, max_slots + 1,
+                             size=max(1, budget) * 64)
+        padded = sum(grid.admit_bucket_for(int(m)) - int(m)
+                     for m in sizes)
+        pad_ratio = padded / (padded + int(sizes.sum()))
+        n_cells = len(buckets) * len(grid.prompt_buckets)
+        score = pad_ratio + CELL_TOLL * n_cells
+        return score, {
+            "admit_buckets": list(buckets),
+            "padded_rows": int(padded),
+            "real_rows": int(sizes.sum()),
+            "pad_ratio": round(float(pad_ratio), 4),  # lint: ok[host-sync] seeded numpy cost model, no device values
+            "cell_toll": CELL_TOLL,
+            "prefill_cells": n_cells,
+            "admissions": int(sizes.size),
+            "budget": budget,
             "seed": seed,
         }
 
@@ -386,6 +490,10 @@ def build_objective(name: str, *, mesh=None, model: str = "lenet5",
         return serve_grid_objective()
     if name == "moe_capacity_factor":
         return moe_capacity_objective()
+    if name == "kv_page_tokens":
+        return kv_page_objective()
+    if name == "decode_admit_buckets":
+        return decode_admit_objective()
     if name == "snapshot_window":
         return snapshot_window_objective()
     if name == "prefetch_depth":

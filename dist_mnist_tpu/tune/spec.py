@@ -180,6 +180,56 @@ KNOBS: dict[str, TunableSpec] = {
             "executable key (serve/engine.py _key/_store_key), so an "
             "applied winner can never collide with a stale executable."),
     ),
+    "kv_page_tokens": TunableSpec(
+        name="kv_page_tokens",
+        subsystem="serve",
+        candidates=(8, 16, 32, 64),
+        default=16,  # models/causal_lm.py CausalLMTiny.kv_page_tokens
+        metric="kv_page_cost",
+        bench_stage="decode",
+        target="serve",
+        compile_relevant=False,  # decode-serving only: folded into every
+        #                          per-cell decode executable key
+        #                          (serve/decode.py _layout_key), never
+        #                          the train-step key
+        doc=(
+            "paged-KV page size in tokens (models/causal_lm.py "
+            "cache_layout='paged'; serve/decode.py page table). The "
+            "objective is a deterministic page-economics cost over the "
+            "seeded decode traffic distribution (serve/loadgen.py "
+            "make_prompts lengths): mean fraction of pinned page tokens "
+            "a request never fills (tail-page waste — small pages win) "
+            "plus a per-table-entry toll for page-table width and the "
+            "extra decode grid cells small pages compile (large pages "
+            "win); the knee is the winner. Page size changes the traced "
+            "decode program, and the live value is part of "
+            "serve/decode.py's per-cell executable key — a tuner-applied "
+            "change forces a fresh compile there, never in train."),
+    ),
+    "decode_admit_buckets": TunableSpec(
+        name="decode_admit_buckets",
+        subsystem="serve",
+        candidates=("auto", "1,2,4,8", "1,4,8", "2,8", "8"),
+        default="auto",  # serve/zoo.default_decode_grid pow2 ladder
+        metric="decode_admit_cost",
+        bench_stage="decode",
+        target="serve",
+        compile_relevant=False,  # each admit bucket is its own prefill
+        #                          cell in the decode grid's executable
+        #                          keys (serve/decode.py _key)
+        doc=(
+            "the decode grid's admit (prefill batch) buckets "
+            "(serve/zoo.py DecodeGrid.admit_buckets), as a comma ladder "
+            "or 'auto' (power-of-two up to max_slots). The objective "
+            "replays a seeded admission-size stream (arrivals drawn "
+            "against the make_prompts traffic shape) through the real "
+            "DecodeGrid bucketing arithmetic and charges every padded "
+            "prefill row, plus CELL_TOLL per extra (admit x prompt) "
+            "grid cell for prewarm/residency. Admit buckets select "
+            "WHICH prefill executable runs — each bucket compiles under "
+            "its own cell key, so the train-step cache key is never "
+            "involved."),
+    ),
     "scan_chunk": TunableSpec(
         name="scan_chunk",
         subsystem="headline",

@@ -62,7 +62,10 @@ run_stage bench_serve_fleet 900 python bench.py --serve --fleet --deadline 800
 run_stage bench_serve_autoscale 900 python bench.py --serve --autoscale --deadline 800
 run_stage bench_serve_longctx 900 python bench.py --serve --longctx --deadline 800
 run_stage bench_serve_quant 900 python bench.py --serve --quant --deadline 800
-run_stage bench_serve_decode 900 python bench.py --serve --decode --requests 64 --concurrency 16 --deadline 800
+# decode gets a bigger budget than its serve siblings: the paged+int8
+# capacity trio (three engines at max_seq=4096 + the teacher-forced
+# replay) runs ~9 min on a forced-8-device CPU mesh, ~2 min stock
+run_stage bench_serve_decode 1500 python bench.py --serve --decode --requests 64 --concurrency 16 --deadline 1400
 run_stage bench_kernels  900 python bench.py --kernels --deadline 800
 run_stage bench_input     900 python bench.py --input --steps 200 --deadline 800
 run_stage bench_memory    900 python bench.py --memory --deadline 800

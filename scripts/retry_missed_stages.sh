@@ -59,7 +59,8 @@ for i in $(seq 1 "$ROUNDS"); do
     run_stage bench_serve_autoscale 900 python bench.py --serve --autoscale --deadline 800
     run_stage bench_serve_longctx 900 python bench.py --serve --longctx --deadline 800
     run_stage bench_serve_quant 900 python bench.py --serve --quant --deadline 800
-    run_stage bench_serve_decode 900 python bench.py --serve --decode --requests 64 --concurrency 16 --deadline 800
+    # bigger budget: the paged+int8 capacity trio (see measure_all.sh)
+    run_stage bench_serve_decode 1500 python bench.py --serve --decode --requests 64 --concurrency 16 --deadline 1400
     run_stage bench_kernels  900 python bench.py --kernels --deadline 800
     run_stage bench_input     900 python bench.py --input --steps 200 --deadline 800
     run_stage bench_memory    900 python bench.py --memory --deadline 800

@@ -1,0 +1,415 @@
+"""Tier-1 paged-KV decode tests (serve/decode.py paged layout +
+models/causal_lm.py + ops/pallas/paged_attention.py).
+
+The paged cache's contracts, in dependency order: (1) paged FLOAT
+prefill/decode is BITWISE the dense twin at every position — at full
+page-table width only (truncating the key axis re-tiles the XLA
+reduction, which is why float grids compile just the full-width decode
+cell); (2) the engine's page allocator never leaks or double-books
+across admit/evict churn, defers admissions an undersized pool cannot
+back, and reuses reclaimed pages; (3) int8 KV token streams agree
+>= 0.99 with the dense float baseline (the quantization accuracy gate);
+(4) TP-sharded paged state (heads over the model axis) is bitwise the
+unsharded run; (5) the Pallas kernel under ``interpret=True`` (the
+off-TPU parity surface) matches the XLA gather reference at every
+decode-grid page bucket, and its visits probe proves `pl.when` page
+skipping; (6) memory-budget accounting charges pages actually pinned,
+not the dense worst case. All CPU-mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dist_mnist_tpu.cluster.mesh import activate
+from dist_mnist_tpu.models.causal_lm import CausalLMTiny
+from dist_mnist_tpu.obs import events
+from dist_mnist_tpu.ops.pallas.paged_attention import (
+    paged_attention,
+    paged_attention_cost,
+    paged_attention_pages,
+    paged_attention_probe,
+)
+from dist_mnist_tpu.ops.quant import QuantizedArray, quantize_kv
+from dist_mnist_tpu.serve import (
+    CompiledModelCache,
+    DecodeScheduler,
+    build_decode_engine,
+    init_lm_for_serving,
+    run_decode_loadgen,
+)
+from dist_mnist_tpu.serve.decode import DecodeEngine
+from dist_mnist_tpu.serve.zoo import DecodeGrid, default_decode_grid
+
+# same small geometry as test_serve_decode.py; pages of 8 tokens give a
+# 4-page-per-slot table — enough structure for every bucket shape
+LM_KW = dict(vocab_size=64, dim=32, depth=2, heads=4, max_seq=32)
+PAGE_T = 8
+PPS = LM_KW["max_seq"] // PAGE_T
+MAX_SLOTS = 4
+PAGED_KW = dict(LM_KW, cache_layout="paged", kv_page_tokens=PAGE_T)
+INT8_KW = dict(PAGED_KW, kv_quant="int8")
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = CausalLMTiny(**LM_KW)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def dense_engine(mesh8):
+    eng = build_decode_engine(mesh8, max_slots=MAX_SLOTS,
+                              cache=CompiledModelCache(), **LM_KW)
+    eng.prewarm()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def paged_engine(mesh8):
+    eng = build_decode_engine(mesh8, max_slots=MAX_SLOTS,
+                              cache=CompiledModelCache(), **PAGED_KW)
+    eng.prewarm()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def int8_engine(mesh8):
+    eng = build_decode_engine(mesh8, max_slots=MAX_SLOTS,
+                              cache=CompiledModelCache(), **INT8_KW)
+    eng.prewarm()
+    return eng
+
+
+def _identity_table(rows: int, pps: int = PPS) -> np.ndarray:
+    """Row r owns pages [r*pps, (r+1)*pps) of an init_cache(rows) pool."""
+    return np.arange(rows * pps, dtype=np.int32).reshape(rows, pps)
+
+
+def _run_streams(engine, *, runahead=1, n=16, seed=7):
+    with DecodeScheduler(engine, mode="continuous",
+                         runahead=runahead) as sched:
+        res = run_decode_loadgen(sched, n_requests=n, concurrency=8,
+                                 seed=seed, keep_streams=True)
+    assert res["recompiles_during_traffic"] == 0
+    return res["streams"]
+
+
+# -- grid: page buckets ------------------------------------------------------
+
+def test_default_grid_page_buckets():
+    flt = default_decode_grid(CausalLMTiny(**PAGED_KW),
+                              max_slots=MAX_SLOTS)
+    # float paged: ONLY the full-width cell (bitwise contract)
+    assert flt.decode_page_buckets == (PPS,)
+    i8 = default_decode_grid(CausalLMTiny(**INT8_KW), max_slots=MAX_SLOTS)
+    assert i8.decode_page_buckets == (1, 2, PPS)
+    assert [c for c in i8.cells() if c[0] == "decode"] == \
+        [("decode", 1), ("decode", 2), ("decode", PPS)]
+    assert i8.decode_page_bucket_for(1) == 1
+    assert i8.decode_page_bucket_for(3) == PPS
+    with pytest.raises(ValueError):
+        i8.decode_page_bucket_for(PPS + 1)
+    dense = default_decode_grid(CausalLMTiny(**LM_KW), max_slots=MAX_SLOTS)
+    assert dense.decode_page_buckets == ()
+    assert dense.cells()[-1] == ("decode",)
+    with pytest.raises(ValueError):
+        dense.decode_page_bucket_for(1)
+
+
+# -- model: paged float is bitwise dense at every position -------------------
+
+def test_paged_float_bitwise_dense_every_position(lm):
+    model, params = lm
+    paged = CausalLMTiny(**PAGED_KW)
+    rng = np.random.default_rng(1)
+    b, plen, steps = 2, 9, 12
+    prompt = rng.integers(0, model.vocab_size, size=(b, plen),
+                          dtype=np.int32)
+    slots = np.arange(b, dtype=np.int32)
+    lengths = np.full(b, plen, np.int32)
+    table = _identity_table(b)
+
+    d_cache = model.init_cache(b)
+    d_last, d_cache = model.prefill(params, d_cache, prompt, slots,
+                                    lengths)
+    p_cache = paged.init_cache(b)
+    p_last, p_cache = paged.prefill(params, p_cache, prompt, slots,
+                                    lengths, page_table=table)
+    np.testing.assert_array_equal(np.asarray(p_last), np.asarray(d_last))
+
+    tok = np.argmax(np.asarray(d_last), axis=-1).astype(np.int32)
+    pos = np.full(b, plen, np.int32)
+    for _ in range(steps):
+        d_log, d_cache = model.decode_step(params, d_cache, tok, pos)
+        p_log, p_cache = paged.decode_step(params, p_cache, tok, pos,
+                                           page_table=table)
+        np.testing.assert_array_equal(np.asarray(p_log),
+                                      np.asarray(d_log))
+        tok = np.argmax(np.asarray(d_log), axis=-1).astype(np.int32)
+        pos = pos + 1
+
+
+# -- engine: allocator invariants --------------------------------------------
+
+def _pinned(eng):
+    return [p for pages in eng._slot_pages.values() for p in pages]
+
+
+def test_page_allocator_churn_no_leak(paged_engine):
+    eng = paged_engine
+    allocatable = eng.num_pages - PPS
+    scratch = set(int(p) for p in eng._scratch_pages)
+    rng = np.random.default_rng(2)
+    held: dict = {}
+    for _ in range(200):
+        slot = int(rng.integers(0, MAX_SLOTS))
+        if slot in held:
+            eng.release_slot(slot)
+            del held[slot]
+        else:
+            total = int(rng.integers(1, LM_KW["max_seq"] + 1))
+            if eng.try_reserve(slot, total):
+                held[slot] = -(-total // PAGE_T)
+        pinned = _pinned(eng)
+        # disjoint, never scratch, conservation
+        assert len(pinned) == len(set(pinned))
+        assert not scratch & set(pinned)
+        assert len(eng._free_pages) + len(pinned) == allocatable
+        assert set(eng._free_pages).isdisjoint(pinned)
+    for slot in list(held):
+        eng.release_slot(slot)
+    assert eng.kv_stats()["kv_pages_pinned"] == 0
+    assert sorted(eng._free_pages) == list(range(allocatable))
+    # released rows re-alias the scratch stripe; a fresh reserve reuses
+    # reclaimed pages rather than growing the pool
+    np.testing.assert_array_equal(eng._page_table[:MAX_SLOTS],
+                                  np.tile(eng._scratch_pages,
+                                          (MAX_SLOTS, 1)))
+    assert eng.try_reserve(0, LM_KW["max_seq"])
+    assert max(_pinned(eng)) < allocatable
+    eng.release_slot(0)
+    eng.release_slot(0)  # idempotent
+    assert eng.kv_stats()["kv_pages_pinned"] == 0
+
+
+def test_undersized_pool_defers_then_completes(mesh8, dense_engine):
+    """A pool backing one slot at a time still finishes every request
+    (admissions defer head-of-line until evictions reclaim pages) and
+    the streams stay bitwise the dense baseline."""
+    model, params = init_lm_for_serving("causal_tiny", seed=0, **PAGED_KW)
+    grid = default_decode_grid(model, max_slots=MAX_SLOTS)
+    eng = DecodeEngine(model, params, mesh8, model_name="causal_tiny",
+                       grid=grid, num_pages=2 * PPS)
+    eng.prewarm()
+    assert eng.try_reserve(0, LM_KW["max_seq"])      # all 4 free pages
+    assert not eng.try_reserve(1, PAGE_T)            # nothing left
+    eng.release_slot(0)
+    assert eng.try_reserve(1, PAGE_T)
+    eng.release_slot(1)
+    assert _run_streams(eng, seed=7) == _run_streams(dense_engine,
+                                                     seed=7)
+    assert eng.kv_stats()["kv_pages_pinned"] == 0
+
+
+# -- engine/scheduler: stream parity -----------------------------------------
+
+def test_paged_streams_bitwise_dense(dense_engine, paged_engine):
+    assert _run_streams(paged_engine, seed=5) == \
+        _run_streams(dense_engine, seed=5)
+
+
+def test_runahead_overlap_streams_identical(paged_engine):
+    """Host/device overlap moves WHEN admissions happen, never what any
+    slot computes: runahead=1 and the serial loop produce identical
+    streams."""
+    assert _run_streams(paged_engine, runahead=1, seed=9) == \
+        _run_streams(paged_engine, runahead=0, seed=9)
+
+
+def test_int8_stream_agreement_gate(dense_engine, int8_engine):
+    dense = _run_streams(dense_engine, n=24, seed=11)
+    i8 = _run_streams(int8_engine, n=24, seed=11)
+    assert len(dense) == len(i8)
+    match = total = 0
+    for a, b in zip(dense, i8):
+        assert len(a) == len(b)  # greedy lengths are request-determined
+        match += sum(x == y for x, y in zip(a, b))
+        total += len(a)
+    assert total > 0
+    assert match / total >= 0.99
+
+
+# -- TP: sharded paged cache bitwise unsharded -------------------------------
+
+def test_tp_paged_bitwise_vs_unsharded(lm, mesh_tp):
+    _, params = lm
+    paged = CausalLMTiny(**PAGED_KW)
+    rng = np.random.default_rng(4)
+    b, plen = 2, 7
+    prompt = rng.integers(0, paged.vocab_size, size=(b, plen),
+                          dtype=np.int32)
+    slots = np.arange(b, dtype=np.int32)
+    lengths = np.full(b, plen, np.int32)
+    table = _identity_table(b)
+
+    def run():
+        cache = paged.init_cache(b)
+        last, cache = paged.prefill(params, cache, prompt, slots,
+                                    lengths, page_table=table)
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        step, cache = paged.decode_step(params, cache, tok,
+                                        np.full(b, plen, np.int32),
+                                        page_table=table)
+        return np.asarray(last), np.asarray(step), np.asarray(cache["k"])
+
+    ref_last, ref_step, ref_k = run()
+    with activate(mesh_tp):
+        tp_last, tp_step, tp_k = run()
+    np.testing.assert_array_equal(tp_last, ref_last)
+    np.testing.assert_array_equal(tp_step, ref_step)
+    np.testing.assert_array_equal(tp_k, ref_k)
+
+
+# -- kernel: parity + page skipping ------------------------------------------
+
+def _quant_pool(rng, n_pages, t=PAGE_T, h=2, d=16):
+    x = jnp.asarray(rng.standard_normal((n_pages, t, h, d)), jnp.float32)
+    q, s = quantize_kv(x)
+    return QuantizedArray(q, s, "kv_head")
+
+
+def _gather_ref(q, kp, vp, table, lengths):
+    """The XLA-path semantics in plain numpy: gather pages through the
+    table, dequantize, masked softmax attention per (row, head)."""
+    k = np.asarray(kp.q, np.float32) * np.asarray(kp.scale, np.float32)
+    v = np.asarray(vp.q, np.float32) * np.asarray(vp.scale, np.float32)
+    r, _, h, d = q.shape
+    n, t = table.shape[1], k.shape[1]
+    out = np.zeros((r, h, d), np.float32)
+    for i in range(r):
+        ki = k[table[i]].reshape(n * t, h, d)
+        vi = v[table[i]].reshape(n * t, h, d)
+        ln = int(lengths[i])
+        for j in range(h):
+            logits = ki[:ln, j] @ np.asarray(q[i, 0, j]) / np.sqrt(d)
+            p = np.exp(logits - logits.max())
+            out[i, j] = (p / p.sum()) @ vi[:ln, j]
+    return out
+
+
+@pytest.mark.parametrize("n_pages", [1, 2, PPS])
+def test_kernel_parity_every_page_bucket(n_pages):
+    """interpret=True parity at every decode-grid page bucket, random
+    tables and ragged lengths — the same cells the int8 engine runs."""
+    rng = np.random.default_rng(20 + n_pages)
+    rows, pool = MAX_SLOTS + 1, 12
+    kp = _quant_pool(rng, pool)
+    vp = _quant_pool(rng, pool)
+    q = jnp.asarray(rng.standard_normal((rows, 1, 2, 16)), jnp.float32)
+    table = np.stack([rng.choice(pool, size=n_pages, replace=False)
+                      for _ in range(rows)]).astype(np.int32)
+    lengths = rng.integers(1, n_pages * PAGE_T + 1,
+                           size=rows).astype(np.int32)
+    out = paged_attention(q, kp, vp, jnp.asarray(table),
+                          jnp.asarray(lengths), interpret=True)
+    ref = _gather_ref(np.asarray(q), kp, vp, table, lengths)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], ref,
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_kernel_visits_probe_counts_active_pages():
+    """`pl.when` page skipping is structural: the visits probe equals
+    ceil(length / T) per row, clipped to the table width — pages past
+    the prefix never enter the compute body."""
+    rng = np.random.default_rng(30)
+    rows, n_pages, pool = 4, PPS, 16
+    kp, vp = _quant_pool(rng, pool), _quant_pool(rng, pool)
+    q = jnp.asarray(rng.standard_normal((rows, 1, 2, 16)), jnp.float32)
+    table = _identity_table(rows, n_pages)
+    lengths = np.asarray([1, PAGE_T, PAGE_T + 1, n_pages * PAGE_T],
+                         np.int32)
+    _, vis = paged_attention_probe(q, kp, vp, jnp.asarray(table),
+                                   jnp.asarray(lengths), interpret=True)
+    expect = np.minimum(np.asarray(paged_attention_pages(lengths, PAGE_T)),
+                        n_pages)
+    np.testing.assert_array_equal(np.asarray(vis),
+                                  np.tile(expect[:, None], (1, 2)))
+
+
+def test_kernel_cost_twin_flops_track_active_pages():
+    """The analytic twin mirrors the kernel's economics: FLOPs scale
+    with ACTIVE pages (the skip predicate), HBM bytes with ALL fetched
+    page tiles (the pipeline DMAs skipped blocks too)."""
+    short = paged_attention_cost([PAGE_T] * 4, PPS, PAGE_T, 4, 8)
+    full = paged_attention_cost([PPS * PAGE_T] * 4, PPS, PAGE_T, 4, 8)
+    assert full["flops"] == PPS * short["flops"]
+    assert full["hbm_bytes"] == short["hbm_bytes"]
+    # truncating the table width IS the bytes lever
+    narrow = paged_attention_cost([PAGE_T] * 4, 1, PAGE_T, 4, 8)
+    assert narrow["hbm_bytes"] < short["hbm_bytes"]
+
+
+# -- byte accounting + journal events ----------------------------------------
+
+def test_byte_accounting_charges_pinned_pages(mesh8):
+    model, params = init_lm_for_serving("causal_tiny", seed=0, **PAGED_KW)
+    grid = default_decode_grid(model, max_slots=MAX_SLOTS)
+    eng = DecodeEngine(model, params, mesh8, model_name="causal_tiny",
+                       grid=grid)
+
+    def expect(pinned_pages):
+        return (eng._params_bytes
+                + eng._page_bytes * (PPS + pinned_pages)) // mesh8.size
+
+    assert eng.cache.base_bytes == expect(0)
+    assert eng.try_reserve(0, 2 * PAGE_T + 1)  # 3 pages
+    assert eng.cache.base_bytes == expect(3)
+    assert eng.kv_stats()["kv_bytes_pinned"] == 3 * eng._page_bytes
+    eng.release_slot(0)
+    assert eng.cache.base_bytes == expect(0)
+    # the dense twin charges its whole stripe up front — the bug this
+    # accounting replaces
+    dense_base = (eng._params_bytes
+                  + sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                        for a in jax.tree.leaves(
+                            CausalLMTiny(**LM_KW).init_cache(grid.rows)))
+                  ) // mesh8.size
+    assert eng.cache.base_bytes < dense_base
+
+
+def test_page_events_journaled(mesh8, tmp_path):
+    model, params = init_lm_for_serving("causal_tiny", seed=0, **PAGED_KW)
+    grid = default_decode_grid(model, max_slots=MAX_SLOTS)
+    eng = DecodeEngine(model, params, mesh8, model_name="causal_tiny",
+                       grid=grid)
+    path = tmp_path / "journal.jsonl"
+    prev = events.set_journal(events.RunJournal(path))
+    try:
+        eng.try_reserve(2, PAGE_T + 1)
+        eng.release_slot(2)
+    finally:
+        events.set_journal(prev)
+    recs = {r["event"]: r for r in events.tail_journal(path)}
+    assert recs["kv_page_alloc"]["slot"] == 2
+    assert recs["kv_page_alloc"]["pages"] == 2
+    assert recs["kv_page_reclaim"]["pages"] == 2
+
+
+# -- engine construction guards ----------------------------------------------
+
+def test_engine_rejects_mismatched_grid(mesh8):
+    model, params = init_lm_for_serving("causal_tiny", seed=0, **PAGED_KW)
+    dense_grid = DecodeGrid(max_slots=MAX_SLOTS, max_seq=LM_KW["max_seq"],
+                            prompt_buckets=(LM_KW["max_seq"],),
+                            admit_buckets=(MAX_SLOTS,))
+    with pytest.raises(ValueError, match="decode_page_buckets"):
+        DecodeEngine(model, params, mesh8, grid=dense_grid)
+    with pytest.raises(ValueError, match="pool"):
+        DecodeEngine(model, params, mesh8,
+                     grid=default_decode_grid(model, max_slots=MAX_SLOTS),
+                     num_pages=PPS)  # scratch only, no slot capacity

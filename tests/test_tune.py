@@ -202,7 +202,8 @@ def test_every_catalog_knob_is_classified():
     flat = set(knob_names())
     assert {"overlap_bucket_mb", "serve_max_batch", "serve_seq_buckets",
             "prefetch_depth", "scan_chunk", "snapshot_window",
-            "moe_capacity_factor"} == flat
+            "moe_capacity_factor", "kv_page_tokens",
+            "decode_admit_buckets"} == flat
     for spec in KNOBS.values():
         assert set(spec.knob_values(spec.default)) == set(
             spec.fields if spec.fields else (spec.name,))
